@@ -1,0 +1,12 @@
+import os
+import sys
+
+# Multi-chip sharding tests run on a virtual 8-device CPU mesh; set this before
+# jax initializes. Tests that need the real TPU must spawn a subprocess.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")

@@ -1,0 +1,41 @@
+"""Byte-golden regression tests against the reference's shipped outputs.
+
+Mirrors the reference test strategy (/root/reference/tests/run_all.sh:30-50):
+exact-byte determinism of consensus / majority-vote / diploid outputs.
+"""
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def run_cli(args):
+    out = io.StringIO()
+    from abpoa_tpu.cli import build_parser, args_to_params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    ns = build_parser().parse_args(args)
+    abpt = args_to_params(ns).finalize()
+    ab = Abpoa()
+    msa_from_file(ab, abpt, ns.input, out)
+    return out.getvalue()
+
+
+def golden(name):
+    with open(os.path.join(GOLDEN_DIR, name)) as fp:
+        return fp.read()
+
+
+def test_consensus_golden():
+    assert run_cli([os.path.join(DATA_DIR, "seq.fa")]) == golden("ref_consensus.txt")
+
+
+def test_majority_vote_golden():
+    assert run_cli([os.path.join(DATA_DIR, "seq.fa"), "-a1"]) == golden("ref_msa.txt")
+
+
+def test_heter_2cons_golden():
+    assert run_cli([os.path.join(DATA_DIR, "heter.fa"), "-d2"]) == golden("ref_heter.txt")
