@@ -43,6 +43,8 @@ def _resolve(abpt: Params) -> Callable:
 
 def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
                                end_node_id: int, query: np.ndarray) -> AlignResult:
+    if g.node_n <= 2:  # empty graph: nothing to align to (abpoa_align.c:196)
+        return AlignResult()
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
     return _resolve(abpt)(g, abpt, beg_node_id, end_node_id, query)

@@ -1,0 +1,49 @@
+"""Cross-mode regression: run the full pipeline in-process on shipped data and
+compare against frozen outputs produced by the reference binary (AVX2).
+
+These goldens were captured once with the reference build; they freeze the
+byte-exact contract for align modes x gap regimes x output modes.
+"""
+import io
+import os
+
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def run_cli(args):
+    out = io.StringIO()
+    from abpoa_tpu.cli import build_parser, args_to_params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    ns = build_parser().parse_args(args)
+    abpt = args_to_params(ns).finalize()
+    ab = Abpoa()
+    msa_from_file(ab, abpt, ns.input, out)
+    return out.getvalue()
+
+
+CONFIGS = [
+    ("seq.fa", ["-m1"], "seq_m1.txt"),
+    ("seq.fa", ["-m2"], "seq_m2.txt"),
+    ("seq.fa", ["-O", "4"], "seq_affine.txt"),
+    ("seq.fa", ["-O", "0"], "seq_linear.txt"),
+    ("seq.fa", ["-b", "-1"], "seq_noband.txt"),
+    ("seq.fa", ["-r2"], "seq_r2.txt"),
+    ("seq.fa", ["-r4"], "seq_r4.txt"),
+    ("seq.fa", ["-r5"], "seq_r5.txt"),
+    ("seq.fa", ["-S", "-p"], "seq_Sp.txt"),
+    ("heter.fa", ["-d2", "-r2"], "heter_d2r2.txt"),
+    ("3alleles.fa", ["-d3"], "3alleles_d3.txt"),
+    ("heter.fq", ["-d2", "-Q"], "heterq_d2Q.txt"),
+]
+
+
+@pytest.mark.parametrize("data,args,golden", CONFIGS, ids=[c[2] for c in CONFIGS])
+def test_config(data, args, golden):
+    path = os.path.join(GOLDEN_DIR, golden)
+    if not os.path.exists(path):
+        pytest.skip(f"golden {golden} not captured")
+    got = run_cli([os.path.join(DATA_DIR, data)] + args)
+    with open(path) as fp:
+        assert got == fp.read()
