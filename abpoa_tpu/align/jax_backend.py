@@ -28,9 +28,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import constants as C
+from ..cigar import push_cigar
 from ..graph import POAGraph
 from ..params import Params
-from .oracle import _DPState, _backtrack, _build_index_map, INT32_MIN
+from .oracle import _build_index_map, INT32_MIN
 from .result import AlignResult
 from .dispatch import register_backend
 
@@ -107,12 +108,15 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
     n_chain_steps = max(1, (Qp - 1).bit_length())
 
     def chain_max(A, ext):
-        # F[j] = max_k (A[j-k] - k*ext): log-step doubling
+        # F[j] = max_k (A[j-k] - k*ext): log-step doubling. Decayed values are
+        # floored at inf_min so long all-inf prefixes cannot wrap int32 (the
+        # reference instead relies on its 512-step inf_min margin).
         F = A
         shift = 1
         for _ in range(n_chain_steps):
-            shifted = jnp.concatenate(
-                [jnp.full(shift, inf, jnp.int32), F[:-shift]]) - shift * ext
+            prev = jnp.concatenate([jnp.full(shift, inf, jnp.int32), F[:-shift]])
+            # floor before subtracting so inf-region cells cannot wrap int32
+            shifted = jnp.maximum(prev, inf + shift * ext) - shift * ext
             F = jnp.maximum(F, shifted)
             shift <<= 1
             if shift >= Qp:
@@ -198,14 +202,14 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
             F2n = jnp.where(in_band, F2n, inf)
             Hrow = jnp.where(in_band, Hrow, inf)
 
-        # ---- adaptive band propagation ------------------------------------
+        # ---- row max (adaptive band + local/extend best) ------------------
+        vals = jnp.where(in_band, Hrow, inf)
+        mx = jnp.max(vals)
+        has = mx > inf
+        eq = (vals == mx) & in_band
+        left = jnp.where(has, jnp.argmax(eq), -1).astype(jnp.int32)
+        right = jnp.where(has, Qp - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
         if banded:
-            vals = jnp.where(in_band, Hrow, inf)
-            mx = jnp.max(vals)
-            has = mx > inf
-            eq = (vals == mx) & in_band
-            left = jnp.where(has, jnp.argmax(eq), -1).astype(jnp.int32)
-            right = jnp.where(has, Qp - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
             om = out_msk[i] & active
             tgt = jnp.where(om, out_idx[i], R)
             mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
@@ -222,12 +226,16 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 F2b = F2b.at[i].set(jnp.where(keep, F2n, F2b[i]))
         dp_beg = dp_beg.at[i].set(jnp.where(keep, beg, dp_beg[i]))
         dp_end = dp_end.at[i].set(jnp.where(keep, end, dp_end[i]))
-        return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr), None
+        return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr), \
+            (jnp.where(keep, mx, inf), jnp.where(keep, left, -1),
+             jnp.where(keep, right, -1))
 
     carry = (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr)
-    carry, _ = lax.scan(body, carry, jnp.arange(1, n_steps + 1, dtype=jnp.int32))
+    carry, rows = lax.scan(body, carry, jnp.arange(1, n_steps + 1, dtype=jnp.int32))
     Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr = carry
-    return Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1]
+    row_max, row_left, row_right = rows
+    return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1],
+            row_max, row_left, row_right)
 
 
 def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
@@ -325,84 +333,140 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
     if qlen:
         qp[:, 1: qlen + 1] = mat[:, query]
 
-    out = _dp_scan(
+    # sink-predecessor candidates for global best (host-known, tiny upload)
+    sink_rows = []
+    for in_id in nodes[end_node_id].in_ids:
+        in_index = int(g.node_id_to_index[in_id])
+        if index_map[in_index]:
+            sink_rows.append(in_index - beg_index)
+    if not sink_rows:
+        sink_rows = [0]
+    SR = _bucket_pow2(len(sink_rows))
+    sink_rows_a = np.zeros(SR, dtype=np.int32)
+    sink_rows_a[: len(sink_rows)] = sink_rows
+    sink_msk = np.zeros(SR, dtype=bool)
+    sink_msk[: len(sink_rows)] = True
+
+    max_ops = R + Qp + 8
+    packed = _dp_full(
         jnp.asarray(base), jnp.asarray(pre_idx), jnp.asarray(pre_msk),
         jnp.asarray(out_idx), jnp.asarray(out_msk), jnp.asarray(row_active_scan),
         jnp.asarray(remain_rows), jnp.asarray(mpl0), jnp.asarray(mpr0),
-        jnp.asarray(qp),
+        jnp.asarray(qp), jnp.asarray(query.astype(np.int32)),
+        jnp.asarray(np.ascontiguousarray(mat.astype(np.int32))),
+        jnp.asarray(sink_rows_a), jnp.asarray(sink_msk),
         jnp.int32(qlen), jnp.int32(w), jnp.int32(remain_end), jnp.int32(inf_min),
         jnp.int32(dp_end0),
         jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1), jnp.int32(abpt.gap_oe1),
         jnp.int32(abpt.gap_open2), jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
-        gap_mode=abpt.gap_mode, local=local, banded=banded, n_steps=R - 1)
-    Hj, E1j, E2j, F1j, F2j, dp_beg_j, dp_end_j, mpl_j, mpr_j = [np.asarray(x) for x in out]
+        gap_mode=abpt.gap_mode, local=local, banded=banded, n_steps=R - 1,
+        align_mode=abpt.align_mode, gap_on_right=bool(abpt.put_gap_on_right),
+        put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
+        ret_cigar=bool(abpt.ret_cigar))
+    packed = np.asarray(packed)  # ONE device->host transfer
 
-    # write back adaptive-band state for subsequent window alignments
+    # unpack: [n_ops, i, j, n_aln, n_match, si, sj, err, best_score, best_i,
+    #          best_j] + mpl(R) + mpr(R) + ops(max_ops*2)
+    (n_ops, fin_i, fin_j, n_aln, n_match, si, sj, err,
+     best_score, best_i, best_j) = [int(x) for x in packed[:11]]
+    off = 11
+    mpl_j = packed[off: off + R]
+    mpr_j = packed[off + R: off + 2 * R]
+    ops = packed[off + 2 * R:].reshape(max_ops, 2)
+
     if banded:
-        for i in range(gn):
-            nid = int(idx2nid[beg_index + i])
-            g.node_id_to_max_pos_left[nid] = mpl_j[i]
-            g.node_id_to_max_pos_right[nid] = mpr_j[i]
+        nids = idx2nid[beg_index: beg_index + gn]
+        g.node_id_to_max_pos_left[nids] = mpl_j[:gn]
+        g.node_id_to_max_pos_right[nids] = mpr_j[:gn]
 
-    # ---- host-side best + backtrack ----------------------------------------
-    n_planes = {C.LINEAR_GAP: 1, C.AFFINE_GAP: 3, C.CONVEX_GAP: 5}[abpt.gap_mode]
-    st = _DPState(1, 0, n_planes, np.dtype(np.int32), inf_min)
-    st.qlen = qlen
-    st.H = Hj[:, : qlen + 1]
-    if n_planes >= 3:
-        st.E1 = E1j[:, : qlen + 1]
-        st.F1 = F1j[:, : qlen + 1]
-    if n_planes >= 5:
-        st.E2 = E2j[:, : qlen + 1]
-        st.F2 = F2j[:, : qlen + 1]
-    st.dp_beg = dp_beg_j
-    st.dp_end = dp_end_j
-
-    pre_index = [[] for _ in range(gn)]
-    pre_ids = [[] for _ in range(gn)]
-    for i in range(1, gn):
-        nid = int(idx2nid[beg_index + i])
-        for j, in_id in enumerate(nodes[nid].in_ids):
-            p_idx = int(g.node_id_to_index[in_id])
-            if index_map[p_idx]:
-                pre_index[i].append(p_idx - beg_index)
-                pre_ids[i].append(j)
-
-    best_score = inf_min
-    best_i = best_j = 0
-    if abpt.align_mode == C.GLOBAL_MODE:
-        for in_id in nodes[end_node_id].in_ids:
-            in_index = int(g.node_id_to_index[in_id])
-            if not index_map[in_index]:
-                continue
-            dp_i = in_index - beg_index
-            end = min(qlen, int(dp_end_j[dp_i]))
-            v = int(st.H[dp_i, end])
-            if v > best_score:
-                best_score, best_i, best_j = v, dp_i, end
-    else:
-        # replay the reference's per-row strict-max update from stored planes
-        for i in range(1, gn - 1):
-            if not row_active[i]:
-                continue
-            b, e = int(dp_beg_j[i]), int(dp_end_j[i])
-            seg = st.H[i, b: e + 1]
-            if len(seg) == 0:
-                continue
-            mx = int(seg.max())
-            if mx <= inf_min:
-                continue
-            if mx > best_score:
-                eq = np.flatnonzero(seg == mx)
-                best_score = mx
-                best_i = i
-                best_j = b + int(eq[-1] if extend else eq[0])
     res.best_score = best_score
+    if not abpt.ret_cigar:
+        return res
+    if err:
+        raise RuntimeError(
+            f"device backtrack failed at ({fin_i},{fin_j}) gap_mode={abpt.gap_mode}")
+    res.n_aln_bases = n_aln
+    res.n_matched_bases = n_match
 
-    if abpt.ret_cigar:
-        _backtrack(g, abpt, st, pre_index, pre_ids, beg_index, best_i, best_j,
-                   qlen, query, res, abpt.gap_mode, inf_min)
+    # rebuild the packed cigar from the op stream (reference order: reversed)
+    cigar: list = []
+    if best_j < qlen:
+        push_cigar(cigar, C.CINS, qlen - best_j, -1, qlen - 1)
+    jj = best_j
+    for t in range(n_ops):
+        opc, dpi = int(ops[t, 0]), int(ops[t, 1])
+        nid = int(idx2nid[beg_index + dpi])
+        if opc == 0:
+            push_cigar(cigar, C.CMATCH, 1, nid, jj - 1)
+            jj -= 1
+        elif opc == 1:
+            push_cigar(cigar, C.CDEL, 1, nid, jj - 1)
+        else:
+            push_cigar(cigar, C.CINS, 1, nid, jj - 1)
+            jj -= 1
+    if fin_j > 0:
+        push_cigar(cigar, C.CINS, fin_j, -1, fin_j - 1)
+    if not abpt.rev_cigar:
+        cigar.reverse()
+    res.cigar = cigar
+    res.node_e = int(idx2nid[best_i + beg_index])
+    res.query_e = best_j - 1
+    res.node_s = int(idx2nid[si + beg_index])
+    res.query_s = sj - 1
     return res
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "local", "banded", "n_steps", "align_mode", "gap_on_right",
+    "put_gap_at_end", "max_ops", "ret_cigar"))
+def _dp_full(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+             remain_rows, mpl0, mpr0, qp, query_pad, mat, sink_rows, sink_msk,
+             qlen, w, remain_end, inf_min, dp_end0,
+             o1, e1, oe1, o2, e2, oe2,
+             gap_mode: int, local: bool, banded: bool, n_steps: int,
+             align_mode: int, gap_on_right: bool, put_gap_at_end: bool,
+             max_ops: int, ret_cigar: bool):
+    """DP scan + best selection + device backtrack, one packed int32 output."""
+    from .jax_backtrack import device_backtrack
+
+    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+     row_max, row_left, row_right) = _dp_scan(
+        base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+        remain_rows, mpl0, mpr0, qp,
+        qlen, w, remain_end, inf_min, dp_end0,
+        o1, e1, oe1, o2, e2, oe2,
+        gap_mode=gap_mode, local=local, banded=banded, n_steps=n_steps)
+
+    if align_mode == C.GLOBAL_MODE:
+        ends = jnp.minimum(qlen, dp_end[sink_rows])
+        vals = jnp.where(sink_msk, Hb[sink_rows, ends], inf_min)
+        k = jnp.argmax(vals)  # first max wins, like the strict > in the reference
+        best_score = vals[k]
+        best_i = sink_rows[k]
+        best_j = ends[k]
+    else:
+        k = jnp.argmax(row_max)  # first row achieving the max
+        best_score = row_max[k]
+        best_i = (k + 1).astype(jnp.int32)
+        best_j = (row_right[k] if align_mode == C.EXTEND_MODE
+                  else row_left[k]).astype(jnp.int32)
+
+    if ret_cigar:
+        ops, n_ops, fi, fj, n_aln, n_match, si, sj, err = device_backtrack(
+            Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
+            base, query_pad, mat, best_i, best_j,
+            e1, oe1, e2, oe2,
+            gap_mode=gap_mode, local=local, gap_on_right=gap_on_right,
+            put_gap_at_end=put_gap_at_end, max_ops=max_ops)
+    else:
+        ops = jnp.zeros((max_ops, 2), jnp.int32)
+        n_ops = fi = fj = n_aln = n_match = si = sj = jnp.int32(0)
+        err = jnp.bool_(False)
+
+    head = jnp.stack([n_ops, fi, fj, n_aln, n_match, si, sj,
+                      err.astype(jnp.int32), best_score,
+                      best_i.astype(jnp.int32), best_j.astype(jnp.int32)])
+    return jnp.concatenate([head, mpl, mpr, ops.reshape(-1)])
 
 
 register_backend("jax", align_sequence_to_subgraph_jax)

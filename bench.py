@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Benchmark harness. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: 20 reads x 2 kb ONT-like consensus (tests/data/sim2k.fa), convex-gap
+global alignment, heaviest-bundling consensus — the reference's default config.
+vs_baseline is speedup over the AVX2 reference binary measured on the dev host
+(bench_baseline.json). Uses the TPU (jax) DP backend when a TPU is present,
+falling back to the NumPy host oracle otherwise.
+"""
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "bench_baseline.json")) as fp:
+        baseline = json.load(fp)["workloads"]["sim2k"]
+
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    device = "numpy"
+    try:
+        import jax
+        if any(d.platform != "cpu" for d in jax.devices()):
+            device = "jax"
+    except Exception:
+        pass
+
+    path = os.path.join(here, baseline["file"])
+    abpt = Params()
+    abpt.device = device
+    abpt.finalize()
+
+    # warmup (compile cache) then timed run
+    ab = Abpoa()
+    msa_from_file(ab, abpt, path, io.StringIO())
+    t0 = time.time()
+    ab = Abpoa()
+    out = io.StringIO()
+    msa_from_file(ab, abpt, path, out)
+    dt = time.time() - t0
+
+    n_reads = baseline["n_reads"]
+    reads_per_sec = n_reads / dt
+    base_rps = n_reads / baseline["avx2_wall_s"]
+    print(json.dumps({
+        "metric": f"reads/sec (2kb ONT consensus, device={device})",
+        "value": round(reads_per_sec, 3),
+        "unit": "reads/sec",
+        "vs_baseline": round(reads_per_sec / base_rps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
