@@ -39,7 +39,12 @@ NEG_PAD = jnp.int32(INT32_MIN // 4)
 
 
 def _bucket(n: int, step: int) -> int:
-    return max(step, ((n + step - 1) // step) * step)
+    """Geometric bucketing (x1.3, rounded to `step`) to bound recompiles as the
+    graph grows read over read."""
+    b = step
+    while b < n:
+        b = ((int(b * 1.3) + step - 1) // step) * step
+    return b
 
 
 def _bucket_pow2(n: int) -> int:
