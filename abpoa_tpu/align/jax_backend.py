@@ -252,98 +252,109 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
 
     res = AlignResult()
     qlen = len(query)
-    beg_index = int(g.node_id_to_index[beg_node_id])
-    end_index = int(g.node_id_to_index[end_node_id])
-    gn = end_index - beg_index + 1
-    index_map = _build_index_map(g, beg_index, end_index)
     local = abpt.align_mode == C.LOCAL_MODE
     extend = abpt.align_mode == C.EXTEND_MODE
     banded = abpt.wb >= 0
     w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
     inf_min = max(INT32_MIN + abpt.min_mis, INT32_MIN + abpt.gap_oe1,
                   INT32_MIN + abpt.gap_oe2) + 512 * max(abpt.gap_ext1, abpt.gap_ext2)
+    Qp = _bucket(qlen + 1, 128)
 
     # ---- dense snapshot over the index window -------------------------------
-    R = _bucket(gn, 64)
-    Qp = _bucket(qlen + 1, 128)
-    nodes = g.nodes
-    idx2nid = g.index_to_node_id
-    base = np.zeros(R, dtype=np.int32)
-    row_active = np.zeros(R, dtype=bool)
-    max_p = 1
-    max_o = 1
-    pre_lists = []
-    out_lists = []
-    for i in range(gn):
-        nid = int(idx2nid[beg_index + i])
-        base[i] = nodes[nid].base
-        row_active[i] = bool(index_map[beg_index + i])
-        if i == 0 or not row_active[i]:
-            pre_lists.append([])
-            out_lists.append([])
-            continue
-        pl = [int(g.node_id_to_index[p]) - beg_index for p in nodes[nid].in_ids
-              if index_map[int(g.node_id_to_index[p])]]
-        pre_lists.append(pl)
-        if banded and i < gn - 1:
-            ol = [int(g.node_id_to_index[o]) - beg_index for o in nodes[nid].out_ids]
-            out_lists.append(ol)
+    if getattr(g, "is_native", False):
+        t = g.build_tables(beg_node_id, end_node_id, banded,
+                           lambda n: _bucket(n, 64), _bucket_pow2)
+        (base, row_active_scan, pre_idx, pre_msk, out_idx, out_msk,
+         remain_rows, mpl0, mpr0) = (
+            t["base"], t["row_active"], t["pre_idx"], t["pre_msk"],
+            t["out_idx"], t["out_msk"], t["remain_rows"], t["mpl0"], t["mpr0"])
+        gn, R, beg_index, remain_end = t["gn"], t["R"], t["beg_index"], t["remain_end"]
+        idx2nid = g.index_to_node_id
+        if banded:
+            r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
+            dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)
         else:
-            out_lists.append([])
-        max_p = max(max_p, len(pl))
-        max_o = max(max_o, len(ol) if banded and i < gn - 1 else 1)
-    P = _bucket_pow2(max_p)
-    O = _bucket_pow2(max_o)
-    pre_idx = np.zeros((R, P), dtype=np.int32)
-    pre_msk = np.zeros((R, P), dtype=bool)
-    out_idx = np.zeros((R, O), dtype=np.int32)
-    out_msk = np.zeros((R, O), dtype=bool)
-    for i in range(gn):
-        pl = pre_lists[i]
-        pre_idx[i, : len(pl)] = pl
-        pre_msk[i, : len(pl)] = True
-        ol = out_lists[i]
-        out_idx[i, : len(ol)] = ol
-        out_msk[i, : len(ol)] = True
-    # last row (end node) is computed like the reference: loop stops before it
-    row_active_scan = row_active.copy()
-    row_active_scan[gn - 1:] = False
-
-    remain_rows = np.zeros(R, dtype=np.int32)
-    mpl0 = np.zeros(R, dtype=np.int32)
-    mpr0 = np.zeros(R, dtype=np.int32)
-    remain_end = 0
-    if banded:
-        remain = g.node_id_to_max_remain
-        mpl_g = g.node_id_to_max_pos_left
-        mpr_g = g.node_id_to_max_pos_right
-        # first-row seeding (abpoa_align_simd.c:617-626)
-        mpl_g[beg_node_id] = mpr_g[beg_node_id] = 0
-        for out_id in nodes[beg_node_id].out_ids:
-            if index_map[int(g.node_id_to_index[out_id])]:
-                mpl_g[out_id] = mpr_g[out_id] = 1
+            dp_end0 = qlen
+    else:
+        beg_index = int(g.node_id_to_index[beg_node_id])
+        end_index = int(g.node_id_to_index[end_node_id])
+        gn = end_index - beg_index + 1
+        index_map = _build_index_map(g, beg_index, end_index)
+        R = _bucket(gn, 64)
+        nodes = g.nodes
+        idx2nid = g.index_to_node_id
+        base = np.zeros(R, dtype=np.int32)
+        row_active = np.zeros(R, dtype=bool)
+        max_p = 1
+        max_o = 1
+        pre_lists = []
+        out_lists = []
         for i in range(gn):
             nid = int(idx2nid[beg_index + i])
-            remain_rows[i] = remain[nid]
-            mpl0[i] = mpl_g[nid]
-            mpr0[i] = mpr_g[nid]
-        remain_end = int(remain[end_node_id])
-        r0 = qlen - (int(remain[beg_node_id]) - remain_end - 1)
-        dp_end0 = min(qlen, max(int(mpr_g[beg_node_id]), r0) + w)
-    else:
-        dp_end0 = qlen
+            base[i] = nodes[nid].base
+            row_active[i] = bool(index_map[beg_index + i])
+            if i == 0 or not row_active[i]:
+                pre_lists.append([])
+                out_lists.append([])
+                continue
+            pl = [int(g.node_id_to_index[p]) - beg_index for p in nodes[nid].in_ids
+                  if index_map[int(g.node_id_to_index[p])]]
+            pre_lists.append(pl)
+            if banded and i < gn - 1:
+                ol = [int(g.node_id_to_index[o]) - beg_index for o in nodes[nid].out_ids]
+                out_lists.append(ol)
+            else:
+                out_lists.append([])
+            max_p = max(max_p, len(pl))
+            max_o = max(max_o, len(ol) if banded and i < gn - 1 else 1)
+        P = _bucket_pow2(max_p)
+        O = _bucket_pow2(max_o)
+        pre_idx = np.zeros((R, P), dtype=np.int32)
+        pre_msk = np.zeros((R, P), dtype=bool)
+        out_idx = np.zeros((R, O), dtype=np.int32)
+        out_msk = np.zeros((R, O), dtype=bool)
+        for i in range(gn):
+            pl = pre_lists[i]
+            pre_idx[i, : len(pl)] = pl
+            pre_msk[i, : len(pl)] = True
+            ol = out_lists[i]
+            out_idx[i, : len(ol)] = ol
+            out_msk[i, : len(ol)] = True
+        # last row (end node) is computed like the reference: loop stops before it
+        row_active_scan = row_active.copy()
+        row_active_scan[gn - 1:] = False
+
+        remain_rows = np.zeros(R, dtype=np.int32)
+        mpl0 = np.zeros(R, dtype=np.int32)
+        mpr0 = np.zeros(R, dtype=np.int32)
+        remain_end = 0
+        if banded:
+            remain = g.node_id_to_max_remain
+            mpl_g = g.node_id_to_max_pos_left
+            mpr_g = g.node_id_to_max_pos_right
+            # first-row seeding (abpoa_align_simd.c:617-626)
+            mpl_g[beg_node_id] = mpr_g[beg_node_id] = 0
+            for out_id in nodes[beg_node_id].out_ids:
+                if index_map[int(g.node_id_to_index[out_id])]:
+                    mpl_g[out_id] = mpr_g[out_id] = 1
+            for i in range(gn):
+                nid = int(idx2nid[beg_index + i])
+                remain_rows[i] = remain[nid]
+                mpl0[i] = mpl_g[nid]
+                mpr0[i] = mpr_g[nid]
+            remain_end = int(remain[end_node_id])
+            r0 = qlen - (int(remain[beg_node_id]) - remain_end - 1)
+            dp_end0 = min(qlen, max(int(mpr_g[beg_node_id]), r0) + w)
+        else:
+            dp_end0 = qlen
 
     mat = abpt.mat
     qp = np.zeros((abpt.m, Qp), dtype=np.int32)
     if qlen:
         qp[:, 1: qlen + 1] = mat[:, query]
 
-    # sink-predecessor candidates for global best (host-known, tiny upload)
-    sink_rows = []
-    for in_id in nodes[end_node_id].in_ids:
-        in_index = int(g.node_id_to_index[in_id])
-        if index_map[in_index]:
-            sink_rows.append(in_index - beg_index)
+    # sink-predecessor candidates for global best = the end row's pre slots
+    sink_rows = [int(x) for x in pre_idx[gn - 1][pre_msk[gn - 1]]]
     if not sink_rows:
         sink_rows = [0]
     SR = _bucket_pow2(len(sink_rows))
@@ -380,9 +391,12 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
     ops = packed[off + 2 * R:].reshape(max_ops, 2)
 
     if banded:
-        nids = idx2nid[beg_index: beg_index + gn]
-        g.node_id_to_max_pos_left[nids] = mpl_j[:gn]
-        g.node_id_to_max_pos_right[nids] = mpr_j[:gn]
+        if getattr(g, "is_native", False):
+            g.write_band(beg_index, gn, mpl_j[:gn], mpr_j[:gn])
+        else:
+            nids = idx2nid[beg_index: beg_index + gn]
+            g.node_id_to_max_pos_left[nids] = mpl_j[:gn]
+            g.node_id_to_max_pos_right[nids] = mpr_j[:gn]
 
     res.best_score = best_score
     if not abpt.ret_cigar:

@@ -75,9 +75,24 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
         g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
 
 
+def _want_native(abpt: Params) -> bool:
+    # native host core pairs with the device kernel; the numpy oracle reads
+    # Python Node objects directly, and the oracle-only corner flags need it
+    return (abpt.device in ("jax", "tpu", "pallas")
+            and not abpt.inc_path_score and abpt.zdrop <= 0)
+
+
 def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
     """File-level driver (reference abpoa_msa1)."""
     assert abpt._finalized, "call Params.finalize() first"
+    if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
+        try:
+            from .native.graph import NativePOAGraph
+            ab.graph = NativePOAGraph()
+        except Exception:
+            pass
+    elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
+        ab.graph = POAGraph()
     ab.reset()
     if abpt.incr_fn:
         from .io.restore import restore_graph
@@ -121,6 +136,8 @@ def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
 def output(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> None:
     """(src/abpoa_align.c:355-371)"""
     g = ab.graph
+    if getattr(g, "is_native", False):
+        g = g.to_python(abpt)  # output-time consumers walk Python nodes
     if abpt.out_gfa:
         generate_gfa(g, abpt, ab.names, ab.is_rc,
                      lambda: generate_consensus(g, abpt, ab.n_seq), out_fp)
