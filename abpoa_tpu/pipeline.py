@@ -79,7 +79,8 @@ def _want_native(abpt: Params) -> bool:
     # native host core pairs with the device kernel; the numpy oracle reads
     # Python Node objects directly, and the oracle-only corner flags need it
     return (abpt.device in ("jax", "tpu", "pallas")
-            and not abpt.inc_path_score and abpt.zdrop <= 0)
+            and not abpt.inc_path_score and abpt.zdrop <= 0
+            and not abpt.incr_fn)
 
 
 def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:

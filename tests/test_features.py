@@ -1,0 +1,71 @@
+"""Feature-path goldens: amino acids, score-matrix files, incremental MSA
+(GFA + MSA restore), file-list batch mode, plot dot output."""
+import io
+import os
+
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def run_cli(args):
+    out = io.StringIO()
+    from abpoa_tpu.cli import build_parser, args_to_params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    ns = build_parser().parse_args(args)
+    abpt = args_to_params(ns).finalize()
+    ab = Abpoa()
+    if ns.in_list:
+        with open(ns.input) as lf:
+            bi = 1
+            for line in lf:
+                fn = line.strip()
+                if fn:
+                    abpt.batch_index = bi
+                    msa_from_file(ab, abpt, fn, out)
+                    bi += 1
+    else:
+        msa_from_file(ab, abpt, ns.input, out)
+    return out.getvalue()
+
+
+def golden(name):
+    with open(os.path.join(GOLDEN_DIR, name)) as fp:
+        return fp.read()
+
+
+def test_amino_acid():
+    got = run_cli([os.path.join(DATA_DIR, "aa.fa"), "-c"])
+    assert got == golden("aa_cons.txt")
+
+
+def test_blosum62():
+    got = run_cli([os.path.join(DATA_DIR, "aa.fa"), "-c",
+                   "-t", os.path.join(DATA_DIR, "BLOSUM62.mtx")])
+    assert got == golden("aa_blosum62.txt")
+
+
+def test_incremental_gfa():
+    got = run_cli([os.path.join(DATA_DIR, "seq4.fa"),
+                   "-i", os.path.join(DATA_DIR, "seq10.gfa")])
+    assert got == golden("incr_gfa.txt")
+
+
+def test_incremental_msa():
+    got = run_cli([os.path.join(DATA_DIR, "seq4.fa"),
+                   "-i", os.path.join(DATA_DIR, "seq10.msa")])
+    assert got == golden("incr_msa.txt")
+
+
+def test_list_mode():
+    got = run_cli([os.path.join(DATA_DIR, "list.txt"), "-l"])
+    assert got == golden("list_mode.txt")
+
+
+def test_plot_dot(tmp_path):
+    out = tmp_path / "g.png"
+    run_cli([os.path.join(DATA_DIR, "test.fa"), "-g", str(out)])
+    dot = str(out) + ".dot"
+    assert os.path.exists(dot)
+    text = open(dot).read()
+    assert "digraph ABPOA_graph" in text and "rank=same" in text

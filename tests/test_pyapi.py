@@ -1,0 +1,69 @@
+"""pyabpoa-API-compat tests: same call surface, consistent with CLI output."""
+import os
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def _read_seqs(path):
+    seqs, cur = [], []
+    with open(path) as fp:
+        for ln in fp:
+            ln = ln.strip()
+            if ln.startswith(">"):
+                if cur:
+                    seqs.append("".join(cur))
+                cur = []
+            elif ln:
+                cur.append(ln)
+    if cur:
+        seqs.append("".join(cur))
+    return seqs
+
+
+def test_msa_consensus_matches_golden():
+    import abpoa_tpu.pyapi as pa
+    seqs = _read_seqs(os.path.join(DATA_DIR, "seq.fa"))
+    a = pa.msa_aligner()
+    res = a.msa(seqs, out_cons=True, out_msa=False)
+    with open(os.path.join(GOLDEN_DIR, "ref_consensus.txt")) as fp:
+        golden_seq = fp.read().splitlines()[1]
+    assert res.n_cons == 1
+    assert res.cons_seq[0] == golden_seq
+    assert res.cons_len[0] == len(golden_seq)
+    assert len(res.cons_cov[0]) == len(golden_seq)
+    assert len(res.cons_qv[0]) == len(golden_seq)
+
+
+def test_msa_rows():
+    import abpoa_tpu.pyapi as pa
+    seqs = _read_seqs(os.path.join(DATA_DIR, "seq.fa"))
+    a = pa.msa_aligner()
+    res = a.msa(seqs, out_cons=True, out_msa=True)
+    assert res.msa_len > 0
+    assert len(res.msa_seq) == len(seqs) + res.n_cons
+    for row in res.msa_seq:
+        assert len(row) == res.msa_len
+
+
+def test_incremental_add():
+    import abpoa_tpu.pyapi as pa
+    seqs = _read_seqs(os.path.join(DATA_DIR, "seq.fa"))
+    a = pa.msa_aligner()
+    a.msa_align(seqs[:5], out_cons=True, out_msa=False)
+    a.msa_add(seqs[5:])
+    res = a.msa_output()
+    b = pa.msa_aligner()
+    res_all = b.msa(seqs, out_cons=True, out_msa=False)
+    assert res.cons_seq == res_all.cons_seq
+
+
+def test_two_cons_diploid():
+    import abpoa_tpu.pyapi as pa
+    seqs = _read_seqs(os.path.join(DATA_DIR, "heter.fa"))
+    a = pa.msa_aligner()
+    res = a.msa(seqs, out_cons=True, out_msa=False, max_n_cons=2)
+    with open(os.path.join(GOLDEN_DIR, "ref_heter.txt")) as fp:
+        lines = fp.read().splitlines()
+    assert res.n_cons == 2
+    assert res.cons_seq[0] == lines[1]
+    assert res.cons_seq[1] == lines[3]
