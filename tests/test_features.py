@@ -64,7 +64,7 @@ def test_list_mode():
 
 def test_plot_dot(tmp_path):
     out = tmp_path / "g.png"
-    run_cli([os.path.join(DATA_DIR, "test.fa"), "-g", str(out)])
+    run_cli([os.path.join(DATA_DIR, "seq.fa"), "-g", str(out)])
     dot = str(out) + ".dot"
     assert os.path.exists(dot)
     text = open(dot).read()
