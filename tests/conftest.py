@@ -1,10 +1,30 @@
 import os
 import sys
 
-# Multi-chip sharding tests run on a virtual 8-device CPU mesh; set this before
-# jax initializes. Tests that need the real TPU must spawn a subprocess.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Multi-chip sharding tests run on a virtual 8-device CPU mesh; force this
+# before jax initializes (the environment may preset JAX_PLATFORMS to a real
+# accelerator). Tests that need the real TPU must spawn a subprocess.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _drop_accelerator_plugins():
+    """Deregister non-CPU PJRT plugins (e.g. the axon TPU tunnel) so CPU-only
+    tests never open a device connection."""
+    try:
+        import jax
+        # the site hook may have read JAX_PLATFORMS before we forced "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        import jax._src.xla_bridge as xb
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+_drop_accelerator_plugins()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

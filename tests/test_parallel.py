@@ -1,0 +1,46 @@
+"""Multi-device tests on the 8-way virtual CPU mesh (see conftest)."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def test_run_batch_multidevice():
+    import jax
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import run_batch
+
+    assert len(jax.devices()) == 8
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.finalize()
+    out = io.StringIO()
+    files = [os.path.join(DATA_DIR, "test.fa"), os.path.join(DATA_DIR, "test.fa")]
+    run_batch(files, abpt, out)
+    text = out.getvalue()
+    assert text.count(">Consensus_sequence") == 2
+
+
+def test_shard_dp_batch_8way():
+    import jax
+    import jax.numpy as jnp
+    from abpoa_tpu.parallel import shard_dp_batch
+
+    mesh, step = shard_dp_batch(8)
+    import __graft_entry__ as ge
+    args = ge._example_inputs()
+    arrays, scalars = args[:10], jnp.stack([jnp.int32(a) for a in args[10:]])
+    stacked = [jnp.broadcast_to(jnp.asarray(a)[None], (8,) + jnp.asarray(a).shape)
+               for a in arrays]
+    stacked.append(jnp.broadcast_to(scalars[None], (8,) + scalars.shape))
+    out = step(*stacked)
+    out.block_until_ready()
+    assert out.shape[0] == 8
+
+
+def test_graft_dryrun():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)
