@@ -122,8 +122,13 @@ def main(argv=None) -> int:
         build_parser().print_help(sys.stderr)
         return 1
     abpt = args_to_params(args).finalize()
+    from .utils import set_verbose, run_stats
+    set_verbose(abpt.verbose)
+    if abpt.verbose >= C.VERBOSE_INFO:
+        print(f"[abpoa_tpu::main] CMD: {' '.join(argv or sys.argv)}", file=sys.stderr)
     out_fp = open(args.output, "w") if args.output and args.output != "-" else sys.stdout
     t0 = time.time()
+    c0 = time.process_time()
     ab = Abpoa()
     try:
         if args.in_list:
@@ -141,7 +146,7 @@ def main(argv=None) -> int:
     finally:
         if out_fp is not sys.stdout:
             out_fp.close()
-    print(f"[abpoa_tpu::main] Real time: {time.time() - t0:.3f} sec.", file=sys.stderr)
+    print(f"[abpoa_tpu::main] {run_stats(t0, c0)}", file=sys.stderr)
     return 0
 
 

@@ -25,10 +25,17 @@ def main():
     from abpoa_tpu.params import Params
     from abpoa_tpu.pipeline import Abpoa, msa_from_file
 
+    # probe the accelerator in a subprocess so a wedged device tunnel cannot
+    # hang the bench; fall back to the host oracle if unreachable
+    import subprocess
     device = "numpy"
     try:
-        import jax
-        if any(d.platform != "cpu" for d in jax.devices()):
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('acc' if any(x.platform!='cpu' for x in d) else 'cpu')"],
+            capture_output=True, text=True, timeout=120)
+        if probe.returncode == 0 and "acc" in probe.stdout:
             device = "jax"
     except Exception:
         pass
