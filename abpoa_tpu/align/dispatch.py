@@ -33,7 +33,9 @@ def _resolve(abpt: Params) -> Callable:
     if name in _BACKENDS:
         return _BACKENDS[name]
     if name in ("jax", "tpu", "pallas"):
-        from . import jax_backend  # lazy: registers "jax"/"pallas"
+        from . import jax_backend  # lazy: registers "jax"
+        if name == "pallas":
+            from . import pallas_backend  # registers "pallas"
         if name == "tpu":
             name = "jax"
         if name in _BACKENDS:

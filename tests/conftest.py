@@ -16,10 +16,6 @@ def _drop_accelerator_plugins():
         import jax
         # the site hook may have read JAX_PLATFORMS before we forced "cpu"
         jax.config.update("jax_platforms", "cpu")
-        import jax._src.xla_bridge as xb
-        for name in list(getattr(xb, "_backend_factories", {})):
-            if name != "cpu":
-                xb._backend_factories.pop(name, None)
     except Exception:
         pass
 

@@ -1,0 +1,46 @@
+"""Pallas banded-kernel parity tests (interpret mode on the CPU mesh)."""
+import io
+import os
+
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def _pallas_importable():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _pallas_importable(),
+                                reason="pallas unavailable in this env")
+
+
+def run_cli(args):
+    out = io.StringIO()
+    from abpoa_tpu.cli import build_parser, args_to_params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    ns = build_parser().parse_args(args)
+    abpt = args_to_params(ns).finalize()
+    ab = Abpoa()
+    msa_from_file(ab, abpt, ns.input, out)
+    return out.getvalue()
+
+
+def golden(name):
+    with open(os.path.join(GOLDEN_DIR, name)) as fp:
+        return fp.read()
+
+
+def test_pallas_consensus_golden():
+    got = run_cli([os.path.join(DATA_DIR, "seq.fa"), "--device", "pallas"])
+    assert got == golden("ref_consensus.txt")
+
+
+def test_pallas_heter_2cons():
+    got = run_cli([os.path.join(DATA_DIR, "heter.fa"), "-d2",
+                   "--device", "pallas"])
+    assert got == golden("ref_heter.txt")
