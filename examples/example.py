@@ -1,0 +1,31 @@
+"""Whole-pipeline API example (counterpart of the reference's example.c):
+align a read set, call both consensus algorithms, print MSA.
+
+Run: python examples/example.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import abpoa_tpu.pyapi as pa
+
+seqs = [
+    "CGTCAATCTATCGAAGCATACGCGGGCAGAGCCGAAGACCTCGGCAATCACA",
+    "CCACGTCAATCTATCGAAGCATACGCGGCAGCCGAACTCGACCTCGGCATCAC",
+    "CGTCAATCTATCGAAGCATACGCGGCAGAGCCCGGAAGACCTCGGCAATCAC",
+    "CGTCAATGCTAGTCGAAGCAGCTGCGGCAGAGCCGAAGACCTCGGCAATCAC",
+    "CGTCAATCTATCGAAGCATTCTACGCGGCAGAGCCGACCTCGGCAATCAC",
+]
+
+# heaviest-bundling consensus + MSA
+a = pa.msa_aligner(aln_mode="g", cons_algrm="HB")
+res = a.msa(seqs, out_cons=True, out_msa=True)
+print("HB consensus:", res.cons_seq[0])
+print("coverage:", res.cons_cov[0][:10], "...")
+res.print_msa()
+
+# majority-vote consensus
+b = pa.msa_aligner(cons_algrm="MF")
+res2 = b.msa(seqs, out_cons=True, out_msa=False)
+print("MF consensus:", res2.cons_seq[0])

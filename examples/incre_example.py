@@ -1,0 +1,50 @@
+"""Incremental-MSA example (counterpart of the reference's incre_example.c):
+build a graph from a first batch, checkpoint it as GFA, restore, and align a
+second batch onto it.
+
+Run: python examples/incre_example.py
+"""
+import io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import abpoa_tpu.pyapi as pa
+from abpoa_tpu.cli import args_to_params, build_parser
+from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+batch1 = [
+    "ACGTGTACAGTTGTGCATTGCAGTACGTACGTACGTTTGCAT",
+    "ACGTGTACCGTTGTGCATTGCAGTACGAACGTACGTTTGCAT",
+]
+batch2 = [
+    "ACGTGTACAGTTGTGCATTACAGTACGTACGAACGTTTGCAT",
+]
+
+with tempfile.TemporaryDirectory() as td:
+    fa1 = os.path.join(td, "b1.fa")
+    gfa = os.path.join(td, "b1.gfa")
+    fa2 = os.path.join(td, "b2.fa")
+    with open(fa1, "w") as f:
+        for i, s in enumerate(batch1):
+            f.write(f">r{i}\n{s}\n")
+    with open(fa2, "w") as f:
+        for i, s in enumerate(batch2):
+            f.write(f">n{i}\n{s}\n")
+
+    # checkpoint batch 1 as GFA
+    ns = build_parser().parse_args([fa1, "-r3"])
+    abpt = args_to_params(ns).finalize()
+    with open(gfa, "w") as out:
+        msa_from_file(Abpoa(), abpt, fa1, out)
+    print("checkpointed GFA:", open(gfa).readline().strip())
+
+    # restore + align batch 2 incrementally
+    ns2 = build_parser().parse_args([fa2, "-i", gfa])
+    abpt2 = args_to_params(ns2).finalize()
+    out = io.StringIO()
+    msa_from_file(Abpoa(), abpt2, fa2, out)
+    print("incremental consensus:")
+    print(out.getvalue())

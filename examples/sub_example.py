@@ -1,0 +1,47 @@
+"""Subgraph-alignment API example (counterpart of the reference's
+sub_example.c): align a fragment against a closed subgraph of the POA DAG
+between two nodes, then fuse it.
+
+Run: python examples/sub_example.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from abpoa_tpu import Params, POAGraph, align_sequence_to_subgraph
+from abpoa_tpu import constants as C
+
+abpt = Params().finalize()
+g = POAGraph()
+
+enc = abpt.char_to_code
+
+
+def encode(s):
+    return enc[np.frombuffer(s.encode(), dtype=np.uint8)].astype(np.uint8)
+
+
+reads = [
+    "ACGTGTACAGTTGTGCATTGCAGTACGTACGTACGTTTGCAT",
+    "ACGTGTACCGTTGTGCATTGCAGTACGAACGTACGTTTGCAT",
+]
+for i, r in enumerate(reads):
+    seq = encode(r)
+    from abpoa_tpu.align import align_sequence_to_graph
+    res = align_sequence_to_graph(g, abpt, seq)
+    g.add_alignment(abpt, seq, None, None, res.cigar, i, len(reads) + 1, True)
+
+# pick an internal window [node 5, node 20], expand to a closed subgraph
+exc_beg, exc_end = g.subgraph_nodes(abpt, 5, 20)
+print(f"closed subgraph boundary nodes: {exc_beg} .. {exc_end}")
+
+frag = encode("GTACAGTTCTGCATT")
+res = align_sequence_to_subgraph(g, abpt, exc_beg, exc_end, frag)
+print("fragment aligned, score:", res.best_score,
+      "cigar ops:", len(res.cigar))
+g.add_subgraph_alignment(abpt, exc_beg, exc_end, frag, None, None,
+                         res.cigar, 2, len(reads) + 1, True)
+print("graph nodes after fusion:", g.node_n)
