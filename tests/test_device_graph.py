@@ -1,0 +1,96 @@
+"""Device-resident graph fusion/topo must agree with the host graph engine.
+
+This validates the round-2 all-device progressive loop's core: run the same
+progressive POA with (a) host-side fusion (graph.py) and (b) jitted
+device-side fusion + topo sort (align/device_graph.py), and compare the full
+graph structure after every read.
+"""
+import numpy as np
+import pytest
+
+from abpoa_tpu import constants as C
+from abpoa_tpu.graph import POAGraph
+from abpoa_tpu.params import Params
+
+
+def _random_reads(rng, n_reads, length, err=0.12):
+    ref = rng.integers(0, 4, length)
+    reads = []
+    for _ in range(n_reads):
+        read = []
+        for b in ref:
+            x = rng.random()
+            if x < err * 0.4:
+                read.append((b + rng.integers(1, 4)) % 4)
+            elif x < err * 0.7:
+                read.append(b)
+                read.append(rng.integers(0, 4))
+            elif x < err:
+                pass
+            else:
+                read.append(b)
+        reads.append(np.array(read, dtype=np.uint8))
+    return reads
+
+
+def test_device_fusion_matches_host():
+    import jax.numpy as jnp
+    from abpoa_tpu.align.device_graph import (DeviceGraph, fuse_alignment,
+                                              init_device_graph, topo_sort,
+                                              ops_from_cigar)
+    from abpoa_tpu.align import align_sequence_to_graph
+
+    rng = np.random.default_rng(3)
+    reads = _random_reads(rng, 5, 120)
+    abpt = Params().finalize()
+
+    host = POAGraph()
+    N, E, A, MAX_OPS = 1024, 8, 4, 512
+    dev = init_device_graph(N, E, A)
+
+    for read_id, seq in enumerate(reads):
+        w = np.ones(len(seq), dtype=np.int64)
+        res_cigar = []
+        if host.node_n > 2:
+            res = align_sequence_to_graph(host, abpt, seq)
+            res_cigar = res.cigar
+        # host fusion
+        host.add_alignment(abpt, seq, w, None, res_cigar, read_id,
+                           len(reads), True)
+        # device fusion of the SAME op stream
+        ops, n_ops = ops_from_cigar(res_cigar, MAX_OPS)
+        qpad = np.zeros(N, dtype=np.int32)
+        qpad[: len(seq)] = seq
+        wpad = np.ones(N, dtype=np.int32)
+        dev = fuse_alignment(dev, jnp.asarray(ops), jnp.int32(n_ops),
+                             jnp.asarray(qpad), jnp.int32(len(seq)),
+                             jnp.asarray(wpad),
+                             C.SRC_NODE_ID, C.SINK_NODE_ID, max_ops=MAX_OPS)
+        dev_sorted, i2n, n2i, remain, ok = topo_sort(dev)
+        dev = dev_sorted  # carry the sorted edge order, like the host engine
+        assert bool(ok), f"device graph overflow at read {read_id}"
+
+        # ---- compare structure -------------------------------------------
+        n = host.node_n
+        assert int(dev.node_n) == n
+        base_d = np.asarray(dev.base)[:n]
+        base_h = np.array([nd.base for nd in host.nodes])
+        np.testing.assert_array_equal(base_d, base_h)
+        out_cnt = np.asarray(dev_sorted.out_cnt)
+        out_ids = np.asarray(dev_sorted.out_ids)
+        out_w = np.asarray(dev_sorted.out_w)
+        in_cnt = np.asarray(dev_sorted.in_cnt)
+        for nid in range(n):
+            nd = host.nodes[nid]
+            assert int(out_cnt[nid]) == len(nd.out_ids), f"node {nid} out_cnt"
+            assert int(in_cnt[nid]) == len(nd.in_ids), f"node {nid} in_cnt"
+            assert list(out_ids[nid][: len(nd.out_ids)]) == nd.out_ids, \
+                f"node {nid} out order"
+            assert list(out_w[nid][: len(nd.out_w)]) == nd.out_w
+            d_al = sorted(np.asarray(dev_sorted.aligned)[nid][: int(np.asarray(dev_sorted.aligned_cnt)[nid])])
+            assert d_al == sorted(nd.aligned_ids), f"node {nid} aligned group"
+        # topo order + max_remain
+        i2n_h = host.index_to_node_id[:n]
+        np.testing.assert_array_equal(np.asarray(i2n)[:n], i2n_h)
+        np.testing.assert_array_equal(np.asarray(remain)[:n],
+                                      host.node_id_to_max_remain[:n])
