@@ -409,6 +409,67 @@ def _chain_of_local_chains(local_chains, anchors, score, pre_id, par_anchors,
     par_anchors[start_n:] = par_anchors[start_n:][::-1]
 
 
+def lis_chaining(anchors: List[int], min_w: int) -> List[int]:
+    """Longest-increasing-subsequence chaining, the reference's alternative to
+    DP chaining for global mode (abpoa_seed.c:593-701): split anchors by
+    strand, LIS over qpos-sorted tpos-ranks per strand, keep the strand with
+    the longer chain, then enforce >= min_w spacing."""
+    n_a = len(anchors)
+    if n_a == 0:
+        return []
+    fwd, rev = [], []
+    for i, a in enumerate(anchors):
+        (rev if a >> 63 else fwd).append(((a & 0xFFFFFFFF) << 32) | (i + 1))
+
+    def lis(rank: List[int], tot_n: int) -> List[int]:
+        rank = sorted(rank)
+        pre = [0] * (tot_n + 1)
+        tails = [rank[0] & 0xFFFFFFFF]
+        for v in rank[1:]:
+            r = v & 0xFFFFFFFF
+            if r < tails[0]:
+                tails[0] = r
+            elif r > tails[-1]:
+                pre[r] = tails[-1]
+                tails.append(r)
+            else:
+                lo, hi = -1, len(tails) - 1
+                while hi - lo > 1:
+                    mid = (lo + hi) // 2
+                    if tails[mid] >= r:
+                        hi = mid
+                    else:
+                        lo = mid
+                tails[hi] = r
+                if hi > 0:
+                    pre[r] = tails[hi - 1]
+        out = []
+        r = tails[-1]
+        while r != 0:
+            out.append(r)
+            r = pre[r]
+        return out[::-1]
+
+    best = []
+    if fwd:
+        best = lis(fwd, n_a)
+    if rev:
+        cand = lis(rev, n_a)
+        if len(cand) > len(best):
+            best = cand
+    out: List[int] = []
+    last_t = last_q = -1
+    for r in best:
+        a = anchors[r - 1]
+        t = (a >> 32) & 0x7FFFFFFF
+        q = a & 0xFFFFFFFF
+        if t - last_t < min_w or q - last_q < min_w:
+            continue
+        out.append(a)
+        last_t, last_q = t, q
+    return out
+
+
 def build_guide_tree_partition(seqs: List[np.ndarray], abpt: Params
                                ) -> Tuple[List[int], List[int], List[int]]:
     """(abpoa_seed.c:717-756). Returns (read_id_map, par_anchors, par_c)."""

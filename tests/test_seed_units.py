@@ -1,0 +1,57 @@
+"""Unit tests for the seeding layer internals."""
+import numpy as np
+
+from abpoa_tpu.params import Params
+from abpoa_tpu.seed import (collect_mm, dp_chaining, lis_chaining, mm_sketch)
+
+
+def test_mm_sketch_positions_sorted_and_within_range():
+    rng = np.random.default_rng(0)
+    seq = rng.integers(0, 4, 500).astype(np.uint8)
+    out = []
+    mm_sketch(seq, 10, 19, 7, False, out)
+    assert out, "sketch should emit minimizers"
+    for x, y in out:
+        rid = y >> 32
+        pos = (y & 0xFFFFFFFF) >> 1
+        assert rid == 7
+        assert 18 <= pos < 500
+
+
+def test_lis_chaining_monotone_spacing():
+    # anchors strand<<63 | tpos<<32 | qpos with a noisy diagonal
+    rng = np.random.default_rng(1)
+    anchors = []
+    for t in range(0, 3000, 37):
+        q = t + int(rng.integers(-5, 6))
+        if q < 0:
+            continue
+        anchors.append((t << 32) | q)
+    anchors.append((1 << 63) | (10 << 32) | 20)  # stray rc anchor
+    anchors.sort()
+    chain = lis_chaining(anchors, min_w=100)
+    assert chain
+    last_t = last_q = -1
+    for a in chain:
+        t = (a >> 32) & 0x7FFFFFFF
+        q = a & 0xFFFFFFFF
+        assert t - last_t >= 100 and q - last_q >= 100
+        assert not (a >> 63)
+        last_t, last_q = t, q
+
+
+def test_dp_chaining_produces_spaced_anchors():
+    abpt = Params()
+    abpt.min_w = 100
+    abpt.finalize()
+    anchors = []
+    for t in range(0, 4000, 41):
+        anchors.append((t << 32) | t)
+    par = []
+    dp_chaining(anchors, abpt, 4000, 4000, par)
+    assert par
+    last_t = -10**9
+    for a in par:
+        t = (a >> 32) & 0x7FFFFFFF
+        assert t - last_t >= abpt.min_w + abpt.k
+        last_t = t
