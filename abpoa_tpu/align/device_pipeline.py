@@ -7,10 +7,11 @@ progressive POA:
   arrays (pure gathers, no host walk) -> _dp_full (scan + best + backtrack on
   device) -> fuse_alignment (device)
 
-The only host involvement per read in this prototype is reversing the
-backtrack op stream into fusion order (a numpy reshuffle of a few KB); round 2
-moves that reversal on-device and wraps the whole loop in one jit, leaving one
-upload + one download per read set (see PERF.md).
+The per-read loop performs NO host synchronization: the backtrack op stream is
+reversed into fusion order on device (`reverse_ops_device`), band/sink scalars
+stay traced, and the Python loop only enqueues async dispatches. Overflow/error
+flags are checked once at the end. Round 2 wraps the loop in a single jitted
+`lax.while_loop` to also amortize per-dispatch overhead (see PERF.md).
 """
 from __future__ import annotations
 
@@ -59,22 +60,24 @@ def build_tables_device(g: DeviceGraph, i2n, n2i, remain):
             remain_rows, mpl0, mpr0)
 
 
-def _ops_backward_to_forward(ops: np.ndarray, n_ops: int, best_j: int,
-                             fin_j: int, qlen: int, max_ops: int
-                             ) -> Tuple[np.ndarray, int]:
+@jax.jit
+def reverse_ops_device(ops, n_ops, best_j, fin_j, qlen, i2n):
     """Backtrack emits ops from the alignment end backwards; fusion consumes
-    them forward with head/tail insertions for unaligned query ends."""
-    rows = []
-    for _ in range(fin_j):
-        rows.append((2, 0))  # leading insertions
-    for t in range(n_ops - 1, -1, -1):
-        rows.append((int(ops[t, 0]), int(ops[t, 1])))
-    for _ in range(qlen - best_j):
-        rows.append((2, 0))  # trailing insertions
-    out = np.zeros((max_ops, 2), dtype=np.int32)
-    n = min(len(rows), max_ops)
-    out[:n] = rows[:n]
-    return out, n
+    them forward with head/tail insertions for unaligned query ends. Runs on
+    device — no host roundtrip between backtrack and fusion."""
+    max_ops = ops.shape[0]
+    k = jnp.arange(max_ops, dtype=jnp.int32)
+    head = fin_j                       # leading INS count
+    mid = head + n_ops                 # reversed op-stream region
+    n_fwd = mid + (qlen - best_j)      # + trailing INS
+    src = jnp.clip(n_ops - 1 - (k - head), 0, max_ops - 1)
+    in_mid = (k >= head) & (k < mid)
+    op = jnp.where(in_mid, ops[src, 0], 2)
+    # map dp-row argument to node id for match/del ops
+    arg = jnp.where(in_mid, i2n[jnp.clip(ops[src, 1], 0, i2n.shape[0] - 1)], 0)
+    fwd = jnp.stack([jnp.where(k < n_fwd, op, 0),
+                     jnp.where(k < n_fwd, arg, 0)], axis=1)
+    return fwd, n_fwd
 
 
 def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
@@ -93,6 +96,7 @@ def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
 
     g = init_device_graph(N, E, A)
     i2n = n2i = remain = None
+    err_any = jnp.bool_(False)
     for read_id, seq in enumerate(seqs):
         qlen = len(seq)
         Qp = _bucket(qlen + 1, 128)
@@ -100,38 +104,36 @@ def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
         wpad = np.ones(N, dtype=np.int32)
         qpad = np.zeros(N, dtype=np.int32)
         qpad[:qlen] = seq
-        if int(g.node_n) == 2:  # seed
+        if read_id == 0:  # seed the empty graph
             ops = jnp.zeros((max_ops, 2), jnp.int32)
             g = fuse_alignment(g, ops, jnp.int32(0), jnp.asarray(qpad),
                                jnp.int32(qlen), jnp.asarray(wpad),
                                C.SRC_NODE_ID, C.SINK_NODE_ID, max_ops=max_ops)
             g, i2n, n2i, remain, ok = topo_sort(g)
-            if not bool(ok):
-                raise RuntimeError("device graph capacity overflow")
             continue
 
+        # --- everything below is async device work: no host sync per read ---
         base, pre_idx, pre_msk, out_idx, out_msk, row_active, remain_rows, \
             mpl0, mpr0 = build_tables_device(g, i2n, n2i, remain)
 
         w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
-        remain_end = int(remain[C.SINK_NODE_ID])
-        r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
-        dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w) if banded else qlen
+        remain_end = remain[C.SINK_NODE_ID]
+        r0 = qlen - (remain_rows[0] - remain_end - 1)
+        dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w) if banded \
+            else jnp.int32(qlen)
 
         qp = np.zeros((abpt.m, Qp), dtype=np.int32)
         qp[:, 1: qlen + 1] = mat[:, seq]
-        sink_rows = np.asarray(pre_idx[int(g.node_n) - 1])
-        sink_msk = np.asarray(pre_msk[int(g.node_n) - 1])
-        if not sink_msk.any():
-            sink_rows = np.zeros_like(sink_rows)
+        sink_rows = pre_idx[g.node_n - 1]
+        sink_msk = pre_msk[g.node_n - 1]
 
         packed = _dp_full(
             base, pre_idx, pre_msk, out_idx, out_msk, row_active,
             remain_rows, mpl0, mpr0, jnp.asarray(qp),
             jnp.asarray(seq.astype(np.int32)), jnp.asarray(mat),
-            jnp.asarray(sink_rows.astype(np.int32)), jnp.asarray(sink_msk),
-            jnp.int32(qlen), jnp.int32(w), jnp.int32(remain_end),
-            jnp.int32(inf_min), jnp.int32(dp_end0),
+            sink_rows, sink_msk,
+            jnp.int32(qlen), jnp.int32(w), remain_end.astype(jnp.int32),
+            jnp.int32(inf_min), dp_end0.astype(jnp.int32),
             jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
             jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
             jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
@@ -140,26 +142,22 @@ def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
             gap_on_right=bool(abpt.put_gap_on_right),
             put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
             ret_cigar=True)
-        packed = np.asarray(packed)
-        n_ops, fin_i, fin_j, _na, _nm, _si, _sj, err, _bs, _bi, best_j = \
-            [int(x) for x in packed[:11]]
-        if err:
-            raise RuntimeError("device backtrack failed in device pipeline")
-        R_ = N
-        ops = packed[11 + 2 * R_:].reshape(max_ops, 2)
-        # row indices -> node ids for match/del ops
-        i2n_h = np.asarray(i2n)
-        fwd = ops.copy()
-        fwd[:, 1] = i2n_h[np.clip(ops[:, 1], 0, N - 1)]
-        fwd_ops, n_fwd = _ops_backward_to_forward(fwd, n_ops, best_j, fin_j,
-                                                  qlen, max_ops)
-        g = fuse_alignment(g, jnp.asarray(fwd_ops), jnp.int32(n_fwd),
-                           jnp.asarray(qpad), jnp.int32(qlen),
-                           jnp.asarray(wpad), C.SRC_NODE_ID, C.SINK_NODE_ID,
-                           max_ops=max_ops)
+        n_ops = packed[0]
+        fin_j = packed[2]
+        err_any = err_any | (packed[7] != 0)
+        best_j = packed[10]
+        ops = packed[11 + 2 * N:].reshape(max_ops, 2)
+        fwd_ops, n_fwd = reverse_ops_device(ops, n_ops, best_j, fin_j,
+                                            jnp.int32(qlen), i2n)
+        g = fuse_alignment(g, fwd_ops, n_fwd, jnp.asarray(qpad),
+                           jnp.int32(qlen), jnp.asarray(wpad),
+                           C.SRC_NODE_ID, C.SINK_NODE_ID, max_ops=max_ops)
         g, i2n, n2i, remain, ok = topo_sort(g)
-        if not bool(ok):
-            raise RuntimeError("device graph capacity overflow")
+    # one sync at the end of the read set
+    if bool(err_any):
+        raise RuntimeError("device backtrack failed in device pipeline")
+    if not bool(g.ok):
+        raise RuntimeError("device graph capacity overflow")
     return g
 
 
