@@ -12,7 +12,11 @@ from abpoa_tpu.cons.consensus import generate_consensus
 from test_device_graph import _random_reads
 
 
-def test_device_pipeline_consensus_matches():
+import pytest
+
+
+@pytest.mark.parametrize("gap", ["convex", "affine"])
+def test_device_pipeline_consensus_matches(gap):
     from abpoa_tpu.align.device_pipeline import (progressive_poa_device,
                                                  device_graph_to_python)
 
@@ -20,6 +24,8 @@ def test_device_pipeline_consensus_matches():
     reads = _random_reads(rng, 6, 140)
     abpt = Params()
     abpt.device = "numpy"
+    if gap == "affine":
+        abpt.gap_open2 = 0
     abpt.finalize()
 
     # standard host pipeline
