@@ -15,7 +15,7 @@ flags are checked once at the end. Round 2 wraps the loop in a single jitted
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List
 
 import numpy as np
 

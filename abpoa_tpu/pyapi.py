@@ -7,8 +7,7 @@ Mirrors /root/reference/python/pyabpoa.pyx: `msa_aligner` with one-shot
 """
 from __future__ import annotations
 
-import sys
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

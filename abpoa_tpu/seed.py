@@ -10,13 +10,12 @@ each solved independently by the DP kernel — the TPU batching unit.
 """
 from __future__ import annotations
 
-import math
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from . import constants as C
-from .align import align_sequence_to_subgraph, AlignResult
+from .align import align_sequence_to_subgraph
 from .cigar import push_cigar
 from .params import Params
 
