@@ -226,8 +226,8 @@ def _get_chain_score(max_bw: int, i_qpos: int, i_tpos: int, j_qpos: int,
     delta_tq = abs(delta_q - delta_t)
     if delta_tq > max_bw:
         return None
-    score -= int((_ilog2_32(delta_tq) >> 1) + delta_tq * 0.01 * k)
-    return score
+    # C semantics: `score -= (double)` truncates the RESULT toward zero
+    return int(score - ((_ilog2_32(delta_tq) >> 1) + delta_tq * 0.01 * k))
 
 
 def _get_local_chain_score(j_end_tpos, j_end_qpos, i_end, anchors, pre_id, score):

@@ -69,3 +69,13 @@ def test_plot_dot(tmp_path):
     assert os.path.exists(dot)
     text = open(dot).read()
     assert "digraph ABPOA_graph" in text and "rank=same" in text
+
+
+def test_rc_mixed_strand_seeded():
+    got = run_cli([os.path.join(DATA_DIR, "rcmix.fa"), "-s", "-S", "-n", "200"])
+    assert got == golden("rcmix_sS.txt")
+
+
+def test_rc_mixed_strand_seeded_progressive():
+    got = run_cli([os.path.join(DATA_DIR, "rcmix.fa"), "-s", "-S", "-p", "-n", "200"])
+    assert got == golden("rcmix_sSp.txt")
