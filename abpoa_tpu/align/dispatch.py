@@ -32,12 +32,15 @@ def _resolve(abpt: Params) -> Callable:
     name = abpt.device
     if name in _BACKENDS:
         return _BACKENDS[name]
-    if name in ("jax", "tpu", "pallas"):
-        from . import jax_backend  # lazy: registers "jax"
-        if name == "pallas":
-            from . import pallas_backend  # registers "pallas"
-        if name == "tpu":
-            name = "jax"
+    if name in ("jax", "tpu", "pallas", "native"):
+        if name == "native":
+            from . import native_backend  # registers "native"
+        else:
+            from . import jax_backend  # lazy: registers "jax"
+            if name == "pallas":
+                from . import pallas_backend  # registers "pallas"
+            if name == "tpu":
+                name = "jax"
         if name in _BACKENDS:
             return _BACKENDS[name]
     raise ValueError(f"Unknown DP backend: {abpt.device}")

@@ -64,5 +64,9 @@ def load():
     lib.apg_get_remain.argtypes = [c.c_void_p, i32p]
     lib.apg_get_remain.restype = c.c_int
     lib.apg_subgraph_nodes.argtypes = [c.c_void_p, c.c_int, c.c_int, i32p]
+    lib.apg_align.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, u8p, c.c_int, i32p, i32p,
+        u64p, c.c_int, i64p]
+    lib.apg_align.restype = c.c_int
     _lib = lib
     return lib

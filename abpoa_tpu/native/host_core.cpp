@@ -546,3 +546,461 @@ int apg_subgraph_nodes(void* h, int inc_beg, int inc_end, int32_t* out2) {
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Native scalar DP kernel: adaptive-banded sequence-to-(sub)graph alignment.
+//
+// Same semantics as the Python/NumPy oracle (abpoa_tpu/align/oracle.py, the
+// golden-verified readable spec of the reference's SIMD kernel): banded
+// storage (one contiguous buffer, per-row offsets), int32 scores, sequential
+// F gap chains, reference backtrack op priority and tie-breaks. Serves as the
+// fast host fallback when no accelerator is reachable, and as the CPU side of
+// the anchored-window pipeline.
+// ===========================================================================
+
+namespace {
+
+const int32_t KINT32_MIN = INT32_MIN;
+
+struct DpPlanes {
+    // banded rows: row i occupies [row_ptr[i], row_ptr[i] + width_i)
+    std::vector<int64_t> row_ptr;
+    std::vector<int32_t> beg, end;
+    std::vector<int32_t> H, E1, E2, F1, F2;
+    int32_t inf = 0;
+
+    inline int32_t get(const std::vector<int32_t>& P, int i, int j) const {
+        if (j < beg[i] || j > end[i]) return inf;
+        return P[row_ptr[i] + (j - beg[i])];
+    }
+    inline int32_t h(int i, int j) const { return get(H, i, j); }
+    inline int32_t e1(int i, int j) const { return get(E1, i, j); }
+    inline int32_t e2(int i, int j) const { return get(E2, i, j); }
+    inline int32_t f1(int i, int j) const { return get(F1, i, j); }
+    inline int32_t f2(int i, int j) const { return get(F2, i, j); }
+};
+
+struct CigBuf {
+    uint64_t* out;
+    int cap, n = 0;
+    bool overflow = false;
+    void push(int op, int len, int64_t node_id, int64_t query_id) {
+        // packed-cigar push with INS-run merging (abpoa_align.h:54-73)
+        if (n > 0 && (op == 1 || op == 4 || op == 5) && (int)(out[n - 1] & 0xF) == op) {
+            out[n - 1] += (uint64_t)len << 4;
+            return;
+        }
+        if (n >= cap) { overflow = true; return; }
+        uint64_t v;
+        if (op == 0 || op == 3) v = (uint64_t)(node_id & 0x3FFFFFFF) << 34 |
+                                     (uint64_t)(query_id & 0x3FFFFFFF) << 4 | op;
+        else if (op == 2) v = (uint64_t)(node_id & 0x3FFFFFFF) << 34 |
+                              (uint64_t)(len & 0x3FFFFFFF) << 4 | op;
+        else v = (uint64_t)(query_id & 0x3FFFFFFF) << 34 |
+                 (uint64_t)(len & 0x3FFFFFFF) << 4 | op;
+        out[n++] = v;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// params layout (int32): [align_mode, gap_mode, wb, wf_x1e6, zdrop, m,
+//                         o1, e1, o2, e2, min_mis, put_gap_on_right,
+//                         put_gap_at_end, ret_cigar]
+// meta out (int64): [best_score, node_s, node_e, query_s, query_e,
+//                    n_aln_bases, n_matched_bases, n_cigar]
+int apg_align(void* h, int beg_node_id, int end_node_id,
+              const uint8_t* query, int qlen, const int32_t* mat,
+              const int32_t* params, uint64_t* cigar_out, int cigar_cap,
+              int64_t* meta) {
+    Graph& g = *(Graph*)h;
+    const int align_mode = params[0], gap_mode = params[1], wb = params[2];
+    const double wf = params[3] / 1e6;
+    const int m = params[5];
+    const int32_t o1 = params[6], e1 = params[7], o2 = params[8], e2 = params[9];
+    const int32_t oe1 = o1 + e1, oe2 = o2 + e2, min_mis = params[10];
+    const bool gap_on_right = params[11] != 0;
+    const bool put_gap_at_end_flag = params[12] != 0;
+    const bool ret_cigar = params[13] != 0;
+    const bool local = align_mode == 1, extend = align_mode == 2;
+    const bool banded = wb >= 0;
+    const bool linear = gap_mode == 0, convex = gap_mode == 2;
+    const int n_planes = linear ? 1 : (gap_mode == 1 ? 3 : 5);
+
+    const int beg_index = g.node_id_to_index[beg_node_id];
+    const int end_index = g.node_id_to_index[end_node_id];
+    const int gn = end_index - beg_index + 1;
+    const int w = banded ? wb + (int)(wf * qlen) : qlen;
+    const int32_t inf = std::max(std::max(KINT32_MIN + min_mis, KINT32_MIN + oe1),
+                                 KINT32_MIN + oe2) + 512 * std::max(e1, e2);
+
+    // subgraph reachability mask (abpoa_align_simd.c:1259-1269)
+    std::vector<uint8_t> index_map(g.n(), 0);
+    index_map[beg_index] = index_map[end_index] = 1;
+    for (int i = beg_index; i < end_index - 1; ++i) {
+        if (!index_map[i]) continue;
+        for (int out_id : g.nodes[g.index_to_node_id[i]].out_ids)
+            index_map[g.node_id_to_index[out_id]] = 1;
+    }
+
+    // filtered predecessor lists per dp row
+    std::vector<std::vector<int32_t>> pre(gn);
+    for (int i = 1; i < gn; ++i) {
+        int nid = g.index_to_node_id[beg_index + i];
+        if (!index_map[beg_index + i]) continue;
+        for (int in_id : g.nodes[nid].in_ids) {
+            int p = g.node_id_to_index[in_id];
+            if (index_map[p]) pre[i].push_back(p - beg_index);
+        }
+    }
+
+    const int32_t remain_end = banded || params[4] > 0 ? g.max_remain[end_node_id] : 0;
+    auto ad_beg = [&](int nid) {
+        int r = qlen - (g.max_remain[nid] - remain_end - 1);
+        return std::max(0, std::min(g.mpl[nid], r) - w);
+    };
+    auto ad_end = [&](int nid) {
+        int r = qlen - (g.max_remain[nid] - remain_end - 1);
+        return std::min(qlen, std::max(g.mpr[nid], r) + w);
+    };
+
+    DpPlanes dp;
+    dp.inf = inf;
+    dp.row_ptr.assign(gn + 1, 0);
+    dp.beg.assign(gn, 0);
+    dp.end.assign(gn, -1);
+
+    // ---- first row --------------------------------------------------------
+    if (banded) {
+        g.mpl[beg_node_id] = g.mpr[beg_node_id] = 0;
+        for (int out_id : g.nodes[beg_node_id].out_ids)
+            if (index_map[g.node_id_to_index[out_id]])
+                g.mpl[out_id] = g.mpr[out_id] = 1;
+        dp.beg[0] = 0;
+        dp.end[0] = ad_end(beg_node_id);
+    } else {
+        dp.beg[0] = 0;
+        dp.end[0] = qlen;
+    }
+
+    // two passes would need bands upfront; instead grow buffers per row
+    auto append_row = [&](int i, int b, int e) {
+        dp.beg[i] = b;
+        dp.end[i] = e;
+        dp.row_ptr[i] = (int64_t)dp.H.size();
+        int width = e - b + 1;
+        dp.H.resize(dp.H.size() + width, inf);
+        if (n_planes >= 3) {
+            dp.E1.resize(dp.H.size(), inf);
+            dp.F1.resize(dp.H.size(), inf);
+        }
+        if (n_planes >= 5) {
+            dp.E2.resize(dp.H.size(), inf);
+            dp.F2.resize(dp.H.size(), inf);
+        }
+    };
+
+    append_row(0, dp.beg[0], dp.end[0]);
+    {
+        int e0 = dp.end[0];
+        int64_t p0 = dp.row_ptr[0];
+        if (local) {
+            for (int j = 0; j <= e0; ++j) {
+                dp.H[p0 + j] = 0;
+                if (n_planes >= 3) dp.E1[p0 + j] = dp.F1[p0 + j] = 0;
+                if (n_planes >= 5) dp.E2[p0 + j] = dp.F2[p0 + j] = 0;
+            }
+        } else if (linear) {
+            for (int j = 0; j <= e0; ++j) dp.H[p0 + j] = -e1 * j;
+        } else if (gap_mode == 1) {
+            dp.H[p0] = 0; dp.E1[p0] = -oe1; dp.F1[p0] = inf;
+            for (int j = 1; j <= e0; ++j) {
+                dp.F1[p0 + j] = -o1 - e1 * j;
+                dp.H[p0 + j] = dp.F1[p0 + j];
+            }
+        } else {
+            dp.H[p0] = 0; dp.E1[p0] = -oe1; dp.E2[p0] = -oe2;
+            dp.F1[p0] = dp.F2[p0] = inf;
+            for (int j = 1; j <= e0; ++j) {
+                dp.F1[p0 + j] = -o1 - e1 * j;
+                dp.F2[p0 + j] = -o2 - e2 * j;
+                dp.H[p0 + j] = std::max(dp.F1[p0 + j], dp.F2[p0 + j]);
+            }
+        }
+    }
+
+    int32_t best_score = inf;
+    int best_i = 0, best_j = 0, best_nid = beg_node_id;
+    std::vector<int32_t> Mq, E1r, E2r, Hh;
+
+    // ---- row loop ---------------------------------------------------------
+    bool zdropped = false;
+    for (int index_i = beg_index + 1; index_i < end_index && !zdropped; ++index_i) {
+        if (!index_map[index_i]) continue;
+        int i = index_i - beg_index;
+        int nid = g.index_to_node_id[index_i];
+        int b, e;
+        if (banded) {
+            b = ad_beg(nid);
+            e = ad_end(nid);
+            int mpb = INT32_MAX;
+            for (int p : pre[i]) mpb = std::min(mpb, dp.beg[p]);
+            if (b < mpb) b = mpb;
+        } else { b = 0; e = qlen; }
+        append_row(i, b, e);
+        int width = e - b + 1;
+        Mq.assign(width, inf);
+        E1r.assign(width, inf);
+        if (convex) E2r.assign(width, inf);
+        const uint8_t base = g.nodes[nid].base;
+        const int32_t* mrow = mat + (int64_t)base * m;
+
+        for (int p : pre[i]) {
+            for (int j = b; j <= e; ++j) {
+                int32_t hp = j >= 1 ? dp.h(p, j - 1) : inf;
+                if (local && j == 0) hp = 0;
+                if (hp > Mq[j - b]) Mq[j - b] = hp;
+                if (linear) {
+                    int32_t ep = dp.h(p, j) - e1;
+                    if (ep > E1r[j - b]) E1r[j - b] = ep;
+                } else {
+                    int32_t ep = dp.e1(p, j);
+                    if (ep > E1r[j - b]) E1r[j - b] = ep;
+                    if (convex) {
+                        int32_t ep2 = dp.e2(p, j);
+                        if (ep2 > E2r[j - b]) E2r[j - b] = ep2;
+                    }
+                }
+            }
+        }
+        // add query profile; Hhat = max(M+q, E)
+        Hh.assign(width, inf);
+        for (int j = b; j <= e; ++j) {
+            int32_t q = j >= 1 ? mrow[query[j - 1]] : 0;
+            Mq[j - b] += q;
+            int32_t v = std::max(Mq[j - b], E1r[j - b]);
+            if (convex) v = std::max(v, E2r[j - b]);
+            Hh[j - b] = v;
+        }
+        int64_t pi = dp.row_ptr[i];
+        if (linear) {
+            // in-row chain on H plane: H[j] = max(H[j], H[j-1]-e1)
+            int32_t prev = Hh[0];
+            dp.H[pi] = local ? std::max(prev, 0) : prev;
+            for (int j = 1; j < width; ++j) {
+                int32_t v = std::max(Hh[j], prev - e1);
+                prev = v;
+                dp.H[pi + j] = local ? std::max(v, 0) : v;
+            }
+        } else {
+            // F chains: F[b]=Mq[b]-oe; F[j]=max(Hh[j-1]-oe, F[j-1]-e)
+            int32_t f1 = Mq[0] - oe1, f2 = convex ? Mq[0] - oe2 : inf;
+            for (int j = 0; j < width; ++j) {
+                if (j > 0) {
+                    f1 = std::max(Hh[j - 1] - oe1, f1 - e1);
+                    if (convex) f2 = std::max(Hh[j - 1] - oe2, f2 - e2);
+                }
+                int32_t hrow = std::max(Hh[j], f1);
+                if (convex) hrow = std::max(hrow, f2);
+                if (local) hrow = std::max(hrow, 0);
+                int32_t e1n;
+                if (gap_mode == 1) {
+                    e1n = (hrow == Hh[j])
+                        ? std::max((int32_t)(E1r[j] - e1), hrow - oe1)
+                        : (local ? 0 : inf);
+                } else {
+                    e1n = std::max((int32_t)(E1r[j] - e1), hrow - oe1);
+                    if (local && e1n < 0) e1n = 0;
+                }
+                dp.H[pi + j] = hrow;
+                dp.E1[pi + j] = e1n;
+                dp.F1[pi + j] = f1;
+                if (convex) {
+                    int32_t e2n = std::max((int32_t)(E2r[j] - e2), hrow - oe2);
+                    if (local && e2n < 0) e2n = 0;
+                    dp.E2[pi + j] = e2n;
+                    dp.F2[pi + j] = f2;
+                }
+            }
+        }
+
+        // ---- row max: local/extend scoring + adaptive band ----------------
+        if (local || extend || banded) {
+            int32_t mx = inf;
+            int left = -1, right = -1;
+            for (int j = 0; j < width; ++j) {
+                int32_t v = dp.H[pi + j];
+                if (v > mx) { mx = v; left = right = b + j; }
+                else if (v == mx && left >= 0) right = b + j;
+            }
+            if (local) {
+                if (mx > best_score) { best_score = mx; best_i = i; best_j = left; }
+            } else if (extend) {
+                if (mx > best_score) {
+                    best_score = mx; best_i = i; best_j = right; best_nid = nid;
+                } else if (params[4] > 0) {
+                    int delta = g.max_remain[best_nid] - g.max_remain[nid];
+                    if (best_score - mx > params[4] + e1 * std::abs(delta - (right - best_j))) {
+                        zdropped = true;
+                        break;
+                    }
+                }
+            }
+            if (banded) {
+                for (int out_id : g.nodes[nid].out_ids) {
+                    if (right + 1 > g.mpr[out_id]) g.mpr[out_id] = right + 1;
+                    if (left + 1 < g.mpl[out_id]) g.mpl[out_id] = left + 1;
+                }
+            }
+        }
+    }
+
+    // ---- global best over the end node's in-rows --------------------------
+    if (align_mode == 0) {
+        for (int in_id : g.nodes[end_node_id].in_ids) {
+            int idx = g.node_id_to_index[in_id];
+            if (!index_map[idx]) continue;
+            int i = idx - beg_index;
+            int e = std::min(qlen, (int)dp.end[i]);
+            int32_t v = dp.h(i, e);
+            if (v > best_score) { best_score = v; best_i = i; best_j = e; }
+        }
+    }
+    meta[0] = best_score;
+    if (!ret_cigar) { meta[7] = 0; return 0; }
+
+    // ---- backtrack (reference op priority, abpoa_align_simd.c:116-458) ----
+    CigBuf cig{cigar_out, cigar_cap};
+    int i = best_i, j = best_j;
+    int start_i = best_i, start_j = best_j;
+    int nid = g.index_to_node_id[i + beg_index];
+    if (best_j < qlen) cig.push(1, qlen - best_j, -1, qlen - 1);
+    int look_gap = put_gap_at_end_flag ? 1 : 0;
+    int cur_op = 0x1F;  // ALL
+    const int M_OP = 1, E1_OP = 2, E2_OP = 4, F1_OP = 8, F2_OP = 16;
+    while (i > 0 && j > 0) {
+        if (local && dp.h(i, j) == 0) break;
+        start_i = i; start_j = j;
+        int32_t s = mat[(int64_t)g.nodes[nid].base * m + query[j - 1]];
+        bool is_match = g.nodes[nid].base == query[j - 1];
+        bool hit = false;
+        int32_t Hij = dp.h(i, j);
+
+        auto try_match = [&]() -> bool {
+            for (int p : pre[i]) {
+                if (j - 1 < dp.beg[p] || j - 1 > dp.end[p]) continue;
+                if (dp.h(p, j - 1) + s == Hij) {
+                    cig.push(0, 1, nid, j - 1);
+                    i = p; --j; nid = g.index_to_node_id[i + beg_index];
+                    cur_op = 0x1F;
+                    meta[5]++; if (is_match) meta[6]++;
+                    return true;
+                }
+            }
+            return false;
+        };
+
+        if (!gap_on_right && look_gap == 0 && (linear || (cur_op & M_OP)))
+            hit = try_match();
+
+        if (!hit) {  // deletion
+            if (linear) {
+                for (int p : pre[i]) {
+                    if (j < dp.beg[p] || j > dp.end[p]) continue;
+                    if (dp.h(p, j) - e1 == Hij) {
+                        cig.push(2, 1, nid, j - 1);
+                        i = p; nid = g.index_to_node_id[i + beg_index];
+                        hit = true; look_gap = 0;
+                        break;
+                    }
+                }
+            } else if (cur_op & (E1_OP | E2_OP)) {
+                for (int p : pre[i]) {
+                    if (j < dp.beg[p] || j > dp.end[p]) continue;
+                    bool done = false;
+                    if (cur_op & E1_OP) {
+                        bool cond = (cur_op & M_OP)
+                            ? (Hij == dp.e1(p, j))
+                            : (dp.e1(i, j) == dp.e1(p, j) - e1);
+                        if (cond) {
+                            cur_op = (dp.h(p, j) - oe1 == dp.e1(p, j))
+                                ? (M_OP | F1_OP | F2_OP) : E1_OP;
+                            cig.push(2, 1, nid, j - 1);
+                            i = p; nid = g.index_to_node_id[i + beg_index];
+                            hit = done = true; look_gap = 0;
+                        }
+                    }
+                    if (!done && convex && (cur_op & E2_OP)) {
+                        bool cond = (cur_op & M_OP)
+                            ? (Hij == dp.e2(p, j))
+                            : (dp.e2(i, j) == dp.e2(p, j) - e2);
+                        if (cond) {
+                            cur_op = (dp.h(p, j) - oe2 == dp.e2(p, j))
+                                ? (M_OP | F1_OP | F2_OP) : E2_OP;
+                            cig.push(2, 1, nid, j - 1);
+                            i = p; nid = g.index_to_node_id[i + beg_index];
+                            hit = done = true; look_gap = 0;
+                        }
+                    }
+                    if (done) break;
+                }
+            }
+        }
+
+        if (!hit) {  // insertion
+            if (linear) {
+                if (dp.h(i, j - 1) - e1 == Hij) {
+                    cig.push(1, 1, nid, j - 1);
+                    --j; look_gap = 0; hit = true; meta[5]++;
+                }
+            } else if (cur_op & (F1_OP | F2_OP)) {
+                bool got = false;
+                if (cur_op & F1_OP) {
+                    bool gate = (cur_op & M_OP) ? (Hij == dp.f1(i, j)) : true;
+                    if (gate) {
+                        if (dp.h(i, j - 1) - oe1 == dp.f1(i, j)) {
+                            cur_op = M_OP | E1_OP | E2_OP; got = true;
+                        } else if (dp.f1(i, j - 1) - e1 == dp.f1(i, j)) {
+                            cur_op = F1_OP; got = true;
+                        }
+                    }
+                }
+                if (!got && convex && (cur_op & F2_OP)) {
+                    bool gate = (cur_op & M_OP) ? (Hij == dp.f2(i, j)) : true;
+                    if (gate) {
+                        if (dp.h(i, j - 1) - oe2 == dp.f2(i, j)) {
+                            cur_op = M_OP | E1_OP | E2_OP; got = true;
+                        } else if (dp.f2(i, j - 1) - e2 == dp.f2(i, j)) {
+                            cur_op = F2_OP; got = true;
+                        }
+                    }
+                }
+                if (got) {
+                    cig.push(1, 1, nid, j - 1);
+                    --j; look_gap = 0; hit = true; meta[5]++;
+                }
+            }
+        }
+
+        if (!hit && (linear || (cur_op & M_OP))) {
+            hit = try_match();
+            if (hit) look_gap = 0;
+        }
+        if (!hit) return -1;  // backtrack failure -> caller falls back
+    }
+    if (j > 0) cig.push(1, j, -1, j - 1);
+    if (cig.overflow) return -2;
+    // reverse (reference emits back-to-front then reverses)
+    for (int a = 0, bb = cig.n - 1; a < bb; ++a, --bb)
+        std::swap(cigar_out[a], cigar_out[bb]);
+    meta[1] = g.index_to_node_id[start_i + beg_index];
+    meta[2] = g.index_to_node_id[best_i + beg_index];
+    meta[3] = start_j - 1;
+    meta[4] = best_j - 1;
+    meta[7] = cig.n;
+    return 0;
+}
+
+}  // extern "C"

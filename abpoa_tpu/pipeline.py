@@ -78,6 +78,8 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
 def _want_native(abpt: Params) -> bool:
     # native host core pairs with the device kernel; the numpy oracle reads
     # Python Node objects directly, and the oracle-only corner flags need it
+    if abpt.device == "native":
+        return not abpt.inc_path_score and not abpt.incr_fn
     return (abpt.device in ("jax", "tpu", "pallas")
             and not abpt.inc_path_score and abpt.zdrop <= 0
             and not abpt.incr_fn)

@@ -26,9 +26,16 @@ def main():
     from abpoa_tpu.pipeline import Abpoa, msa_from_file
 
     # probe the accelerator in a subprocess so a wedged device tunnel cannot
-    # hang the bench; fall back to the host oracle if unreachable
+    # hang the bench; fall back to the native C++ host kernel (then the NumPy
+    # oracle) if no accelerator is reachable
     import subprocess
     device = "numpy"
+    try:
+        from abpoa_tpu.native import load
+        if load() is not None:
+            device = "native"
+    except Exception:
+        pass
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
