@@ -1,0 +1,67 @@
+"""C++ DP kernel (device=native) byte-golden tests."""
+import io
+import os
+
+import pytest
+
+from conftest import DATA_DIR, GOLDEN_DIR
+
+
+def _native_available():
+    try:
+        from abpoa_tpu.native import load
+        return load() is not None
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _native_available(),
+                                reason="native core unavailable")
+
+
+def run_cli(args):
+    out = io.StringIO()
+    from abpoa_tpu.cli import build_parser, args_to_params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    ns = build_parser().parse_args(args)
+    abpt = args_to_params(ns).finalize()
+    ab = Abpoa()
+    msa_from_file(ab, abpt, ns.input, out)
+    assert getattr(ab.graph, "is_native", False), "native path not engaged"
+    return out.getvalue()
+
+
+def golden(name):
+    with open(os.path.join(GOLDEN_DIR, name)) as fp:
+        return fp.read()
+
+
+def test_native_consensus():
+    got = run_cli([os.path.join(DATA_DIR, "seq.fa"), "--device", "native"])
+    assert got == golden("ref_consensus.txt")
+
+
+def test_native_heter_2cons():
+    got = run_cli([os.path.join(DATA_DIR, "heter.fa"), "-d2", "--device", "native"])
+    assert got == golden("ref_heter.txt")
+
+
+def test_native_seeded_progressive():
+    got = run_cli([os.path.join(DATA_DIR, "seq.fa"), "-S", "-p", "--device", "native"])
+    assert got == golden("seq_Sp.txt")
+
+
+def test_native_rc_mixed_seeded():
+    got = run_cli([os.path.join(DATA_DIR, "rcmix.fa"), "-s", "-S", "-n", "200",
+                   "--device", "native"])
+    assert got == golden("rcmix_sS.txt")
+
+
+def test_native_local_mode():
+    got = run_cli([os.path.join(DATA_DIR, "seq.fa"), "-m1", "--device", "native"])
+    assert got == golden("seq_m1.txt")
+
+
+def test_native_extend_mode():
+    got = run_cli([os.path.join(DATA_DIR, "seq.fa"), "-m2", "--device", "native"])
+    assert got == golden("seq_m2.txt")
