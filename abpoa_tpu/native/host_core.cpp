@@ -34,6 +34,11 @@ struct Graph {
     std::vector<int32_t> max_remain, mpl, mpr, msa_rank;
     bool sorted = false;
     bool msa_rank_set = false;
+    // persistent DP workspaces (reused across alignments, like the
+    // reference's abpoa_simd_matrix_t)
+    std::vector<int32_t> wsH, wsE1, wsE2, wsF1, wsF2;
+    std::vector<int64_t> ws_row_ptr;
+    std::vector<int32_t> ws_beg, ws_end;
 
     Graph() { reset(); }
     void reset() {
@@ -564,10 +569,46 @@ const int32_t KINT32_MIN = INT32_MIN;
 
 struct DpPlanes {
     // banded rows: row i occupies [row_ptr[i], row_ptr[i] + width_i)
-    std::vector<int64_t> row_ptr;
-    std::vector<int32_t> beg, end;
-    std::vector<int32_t> H, E1, E2, F1, F2;
+    // views over the graph's persistent workspaces (no per-call allocation)
+    std::vector<int64_t>& row_ptr;
+    std::vector<int32_t>& beg;
+    std::vector<int32_t>& end;
+    std::vector<int32_t>& H;
+    std::vector<int32_t>& E1;
+    std::vector<int32_t>& E2;
+    std::vector<int32_t>& F1;
+    std::vector<int32_t>& F2;
+    int64_t used = 0;
     int32_t inf = 0;
+    int n_planes = 5;
+
+    explicit DpPlanes(Graph& g)
+        : row_ptr(g.ws_row_ptr), beg(g.ws_beg), end(g.ws_end),
+          H(g.wsH), E1(g.wsE1), E2(g.wsE2), F1(g.wsF1), F2(g.wsF2) {}
+
+    void start(int gn, int np) {
+        n_planes = np;
+        used = 0;
+        if ((int)row_ptr.size() < gn + 1) {
+            row_ptr.resize(gn + 1);
+            beg.resize(gn);
+            end.resize(gn);
+        }
+        std::fill(beg.begin(), beg.begin() + gn, 0);
+        std::fill(end.begin(), end.begin() + gn, -1);
+    }
+    void append_row(int i, int b, int e) {
+        beg[i] = b;
+        end[i] = e;
+        row_ptr[i] = used;
+        used += e - b + 1;
+        if ((int64_t)H.size() < used) {
+            int64_t cap = std::max<int64_t>(used, (int64_t)H.size() * 2);
+            H.resize(cap);
+            if (n_planes >= 3) { E1.resize(cap); F1.resize(cap); }
+            if (n_planes >= 5) { E2.resize(cap); F2.resize(cap); }
+        }
+    }
 
     inline int32_t get(const std::vector<int32_t>& P, int i, int j) const {
         if (j < beg[i] || j > end[i]) return inf;
@@ -666,11 +707,9 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         return std::min(qlen, std::max(g.mpr[nid], r) + w);
     };
 
-    DpPlanes dp;
+    DpPlanes dp(g);
     dp.inf = inf;
-    dp.row_ptr.assign(gn + 1, 0);
-    dp.beg.assign(gn, 0);
-    dp.end.assign(gn, -1);
+    dp.start(gn, n_planes);
 
     // ---- first row --------------------------------------------------------
     if (banded) {
@@ -685,24 +724,12 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         dp.end[0] = qlen;
     }
 
-    // two passes would need bands upfront; instead grow buffers per row
-    auto append_row = [&](int i, int b, int e) {
-        dp.beg[i] = b;
-        dp.end[i] = e;
-        dp.row_ptr[i] = (int64_t)dp.H.size();
-        int width = e - b + 1;
-        dp.H.resize(dp.H.size() + width, inf);
-        if (n_planes >= 3) {
-            dp.E1.resize(dp.H.size(), inf);
-            dp.F1.resize(dp.H.size(), inf);
-        }
-        if (n_planes >= 5) {
-            dp.E2.resize(dp.H.size(), inf);
-            dp.F2.resize(dp.H.size(), inf);
-        }
-    };
+    auto append_row = [&](int i, int b, int e) { dp.append_row(i, b, e); };
 
-    append_row(0, dp.beg[0], dp.end[0]);
+    {
+        int b0 = dp.beg[0], e0 = dp.end[0];
+        append_row(0, b0, e0);
+    }
     {
         int e0 = dp.end[0];
         int64_t p0 = dp.row_ptr[0];
@@ -719,6 +746,7 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             for (int j = 1; j <= e0; ++j) {
                 dp.F1[p0 + j] = -o1 - e1 * j;
                 dp.H[p0 + j] = dp.F1[p0 + j];
+                dp.E1[p0 + j] = inf;
             }
         } else {
             dp.H[p0] = 0; dp.E1[p0] = -oe1; dp.E2[p0] = -oe2;
@@ -727,6 +755,7 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                 dp.F1[p0 + j] = -o1 - e1 * j;
                 dp.F2[p0 + j] = -o2 - e2 * j;
                 dp.H[p0 + j] = std::max(dp.F1[p0 + j], dp.F2[p0 + j]);
+                dp.E1[p0 + j] = dp.E2[p0 + j] = inf;
             }
         }
     }
