@@ -14,7 +14,7 @@ _lib = None
 
 
 def _build() -> None:
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
     subprocess.run(cmd, check=True, capture_output=True)
 
 

@@ -781,29 +781,47 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         append_row(i, b, e);
         int width = e - b + 1;
         Mq.assign(width, inf);
-        E1r.assign(width, inf);
+        // linear-gap E candidates are (pred H - e1); uncovered cells carry
+        // inf-e1 in the oracle's full-width arithmetic — replicate exactly
+        E1r.assign(width, linear ? inf - e1 : inf);
         if (convex) E2r.assign(width, inf);
         const uint8_t base = g.nodes[nid].base;
         const int32_t* mrow = mat + (int64_t)base * m;
 
         for (int p : pre[i]) {
-            for (int j = b; j <= e; ++j) {
-                int32_t hp = j >= 1 ? dp.h(p, j - 1) : inf;
-                if (local && j == 0) hp = 0;
-                if (hp > Mq[j - b]) Mq[j - b] = hp;
+            const int pb = dp.beg[p], pe = dp.end[p];
+            const int64_t pp = dp.row_ptr[p];
+            // M from pred H at j-1: overlap of [b,e] with [pb+1, pe+1]
+            {
+                const int lo = std::max(b, pb + 1), hi = std::min(e, pe + 1);
+                const int32_t* Hp = dp.H.data() + pp - pb;  // Hp[j-1] valid
+                int32_t* Mqp = Mq.data() - b;
+                for (int j = lo; j <= hi; ++j)
+                    Mqp[j] = std::max(Mqp[j], Hp[j - 1]);
+            }
+            // E from pred at j: overlap of [b,e] with [pb, pe]
+            {
+                const int lo = std::max(b, pb), hi = std::min(e, pe);
                 if (linear) {
-                    int32_t ep = dp.h(p, j) - e1;
-                    if (ep > E1r[j - b]) E1r[j - b] = ep;
+                    const int32_t* Hp = dp.H.data() + pp - pb;
+                    int32_t* Ep = E1r.data() - b;
+                    for (int j = lo; j <= hi; ++j)
+                        Ep[j] = std::max(Ep[j], Hp[j] - e1);
                 } else {
-                    int32_t ep = dp.e1(p, j);
-                    if (ep > E1r[j - b]) E1r[j - b] = ep;
+                    const int32_t* E1p = dp.E1.data() + pp - pb;
+                    int32_t* Ep = E1r.data() - b;
+                    for (int j = lo; j <= hi; ++j)
+                        Ep[j] = std::max(Ep[j], E1p[j]);
                     if (convex) {
-                        int32_t ep2 = dp.e2(p, j);
-                        if (ep2 > E2r[j - b]) E2r[j - b] = ep2;
+                        const int32_t* E2p = dp.E2.data() + pp - pb;
+                        int32_t* E2o = E2r.data() - b;
+                        for (int j = lo; j <= hi; ++j)
+                            E2o[j] = std::max(E2o[j], E2p[j]);
                     }
                 }
             }
         }
+        if (local && b == 0 && Mq[0] < 0) Mq[0] = 0;  // H[-1] treated as 0
         // add query profile; Hhat = max(M+q, E)
         Hh.assign(width, inf);
         for (int j = b; j <= e; ++j) {
