@@ -27,7 +27,7 @@ from ..params import Params
 from .device_graph import (DeviceGraph, fuse_alignment, init_device_graph,
                            topo_sort)
 from .jax_backend import _bucket, _dp_full
-from .oracle import INT32_MIN
+from .oracle import INT32_MIN, dp_inf_min
 
 
 @jax.jit
@@ -89,8 +89,7 @@ def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
     Requires global mode + banded + convex/affine/linear without path scores.
     """
     assert abpt.align_mode == C.GLOBAL_MODE and not abpt.inc_path_score
-    inf_min = max(INT32_MIN + abpt.min_mis, INT32_MIN + abpt.gap_oe1,
-                  INT32_MIN + abpt.gap_oe2) + 512 * max(abpt.gap_ext1, abpt.gap_ext2)
+    inf_min = dp_inf_min(abpt)
     banded = abpt.wb >= 0
     mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
 

@@ -31,7 +31,7 @@ from .. import constants as C
 from ..cigar import push_cigar
 from ..graph import POAGraph
 from ..params import Params
-from .oracle import _build_index_map, INT32_MIN
+from .oracle import _build_index_map, INT32_MIN, dp_inf_min
 from .result import AlignResult
 from .dispatch import register_backend
 
@@ -256,8 +256,7 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
     extend = abpt.align_mode == C.EXTEND_MODE
     banded = abpt.wb >= 0
     w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
-    inf_min = max(INT32_MIN + abpt.min_mis, INT32_MIN + abpt.gap_oe1,
-                  INT32_MIN + abpt.gap_oe2) + 512 * max(abpt.gap_ext1, abpt.gap_ext2)
+    inf_min = dp_inf_min(abpt)
     Qp = _bucket(qlen + 1, 128)
 
     # ---- dense snapshot over the index window -------------------------------

@@ -34,19 +34,24 @@ INT16_MIN = -32768
 INT32_MIN = -2147483648
 
 
+def dp_inf_min(abpt: Params, dtype_min: int = INT32_MIN) -> int:
+    """-inf clamp for DP cells: far enough below any reachable score that
+    subtraction chains cannot wrap (the 512-step margin mirrors the
+    reference's underflow headroom, abpoa_align_simd.c:1293-1302)."""
+    return (max(dtype_min + abpt.min_mis, dtype_min + abpt.gap_oe1,
+                dtype_min + abpt.gap_oe2)
+            + 512 * max(abpt.gap_ext1, abpt.gap_ext2))
+
+
 def _select_dtype(abpt: Params, qlen: int, gn: int) -> Tuple[np.dtype, int]:
     """Score width promotion (abpoa_align_simd.c:1284-1302)."""
-    ge1, ge2 = abpt.gap_ext1, abpt.gap_ext2
+    ge1 = abpt.gap_ext1
     oe1, oe2 = abpt.gap_oe1, abpt.gap_oe2
     ln = max(qlen, gn)
     max_score = max(qlen * abpt.max_mat, ln * ge1 + abpt.gap_open1)
     if max_score <= INT16_MAX - abpt.min_mis - oe1 - oe2:
-        inf_min = max(INT16_MIN + abpt.min_mis, INT16_MIN + oe1, INT16_MIN + oe2) \
-            + 512 * max(ge1, ge2)
-        return np.dtype(np.int16), inf_min
-    inf_min = max(INT32_MIN + abpt.min_mis, INT32_MIN + oe1, INT32_MIN + oe2) \
-        + 512 * max(ge1, ge2)
-    return np.dtype(np.int32), inf_min
+        return np.dtype(np.int16), dp_inf_min(abpt, INT16_MIN)
+    return np.dtype(np.int32), dp_inf_min(abpt, INT32_MIN)
 
 
 def _build_index_map(g: POAGraph, beg_index: int, end_index: int) -> np.ndarray:

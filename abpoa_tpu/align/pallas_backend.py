@@ -17,7 +17,7 @@ from ..params import Params
 from .dispatch import register_backend
 from .jax_backend import (_bucket, _bucket_pow2,
                           align_sequence_to_subgraph_jax)
-from .oracle import INT32_MIN, _DPState, _backtrack, _build_index_map
+from .oracle import INT32_MIN, _DPState, _backtrack, _build_index_map, dp_inf_min
 from .result import AlignResult
 
 
@@ -66,8 +66,7 @@ def align_sequence_to_subgraph_pallas(g: POAGraph, abpt: Params, beg_node_id: in
 
     qlen = len(query)
     w = abpt.wb + int(abpt.wf * qlen)
-    inf_min = max(INT32_MIN + abpt.min_mis, INT32_MIN + abpt.gap_oe1,
-                  INT32_MIN + abpt.gap_oe2) + 512 * max(abpt.gap_ext1, abpt.gap_ext2)
+    inf_min = dp_inf_min(abpt)
 
     # ---- snapshot tables (native core when available) -----------------------
     if getattr(g, "is_native", False):

@@ -75,6 +75,26 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
         g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
 
 
+def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
+                      exist_n_seq: int) -> bool:
+    """Route the plain progressive loop through the single-dispatch all-device
+    path when the device backend is selected and the config is in scope
+    (align/fused_loop.py). Returns False to fall back to the per-read loop."""
+    if abpt.device not in ("jax", "tpu") or exist_n_seq:
+        return False
+    from .align.fused_loop import fused_eligible, progressive_poa_fused
+    if not fused_eligible(abpt, len(seqs)):
+        return False
+    try:
+        pg, _ = progressive_poa_fused(seqs, weights, abpt)
+    except RuntimeError as e:
+        print(f"Warning: fused device loop failed ({e}); "
+              "falling back to the per-read loop.", file=sys.stderr)
+        return False
+    ab.graph = pg
+    return True
+
+
 def _want_native(abpt: Params) -> bool:
     # native host core pairs with the device kernel; the numpy oracle reads
     # Python Node objects directly, and the oracle-only corner flags need it
@@ -128,7 +148,8 @@ def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
             weights.append(np.ones(len(arr), dtype=np.int64))
 
     if (abpt.disable_seeding and not abpt.progressive_poa) or abpt.align_mode != C.GLOBAL_MODE:
-        poa(ab, abpt, seqs, weights, exist_n_seq)
+        if not _run_fused_device(ab, abpt, seqs, weights, exist_n_seq):
+            poa(ab, abpt, seqs, weights, exist_n_seq)
     else:
         from .seed import anchor_poa_pipeline
         anchor_poa_pipeline(ab, abpt, seqs, weights, exist_n_seq)
