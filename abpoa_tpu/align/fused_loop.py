@@ -66,7 +66,7 @@ from .. import constants as C
 from ..params import Params
 from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
 from .jax_backend import _bucket, _bucket_pow2
-from .oracle import INT32_MIN, dp_inf_min
+from .oracle import INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit
 
 # error codes reported by the fused loop (state.err)
 ERR_OK = 0
@@ -78,6 +78,7 @@ ERR_OPS_CAP = 5      # op stream longer than max_ops -> grow N (max_ops tracks N
 ERR_ALIGN_CAP = 6    # aligned-group slots A exhausted -> grow A (aa alphabets)
 ERR_GRAPH_CAP = 7    # capacity hit inside the sequential fusion/Kahn fallback
 #                      (no specific dimension reported) -> grow N, E and A
+ERR_PROMOTE = 8      # int16 score bound exceeded -> switch planes to int32
 
 
 class FusedState(NamedTuple):
@@ -156,12 +157,12 @@ def _remain_doubling(g: DeviceGraph) -> jnp.ndarray:
 # banded DP over graph rows                                                   #
 # --------------------------------------------------------------------------- #
 
-@functools.partial(jax.jit, static_argnames=("gap_mode", "W"))
+@functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16"))
 def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                remain_rows, mpl0, mpr0, qp, n_rows,
                qlen, w, remain_end, inf_min, dp_end0,
                o1, e1, oe1, o2, e2, oe2,
-               gap_mode: int, W: int):
+               gap_mode: int, W: int, plane16: bool = False):
     """Adaptive-banded DP with W-wide windowed plane storage.
 
     Row i stores plane cells for absolute columns [dp_beg[i], dp_beg[i]+W);
@@ -174,33 +175,39 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     """
     R = base_r.shape[0]
     P = pre_idx.shape[1]
-    inf = inf_min
+    # int16 planes double the effective VPU lanes when the score bound allows
+    # (the reference's width promotion, abpoa_align_simd.c:1293-1302)
+    dt = jnp.int16 if plane16 else jnp.int32
+    inf = inf_min.astype(dt)
+    o1, e1, oe1, o2, e2, oe2 = [x.astype(dt) for x in (o1, e1, oe1, o2, e2, oe2)]
+    qp = qp.astype(dt)
     convex = gap_mode == C.CONVEX_GAP
     linear = gap_mode == C.LINEAR_GAP
     kw = jnp.arange(W, dtype=jnp.int32)
+    kw_dt = kw.astype(dt)
 
     # ---- first row: absolute cols [0, dp_end0] ------------------------------
     colv = kw <= dp_end0
     if linear:
-        H0 = jnp.where(colv, -e1 * kw, inf)
-        E10 = E20 = F10 = F20 = jnp.full(W, inf, jnp.int32)
+        H0 = jnp.where(colv, -e1 * kw_dt, inf)
+        E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
     else:
-        f1r = -o1 - e1 * kw
-        f2r = -o2 - e2 * kw
+        f1r = -o1 - e1 * kw_dt
+        f2r = -o2 - e2 * kw_dt
         F10 = jnp.where(colv & (kw >= 1), f1r, inf)
         F20 = jnp.where(colv & (kw >= 1), f2r, inf) if convex \
-            else jnp.full(W, inf, jnp.int32)
+            else jnp.full(W, inf, dt)
         h0 = jnp.maximum(f1r, f2r) if convex else f1r
         H0 = jnp.where(colv & (kw >= 1), h0, inf).at[0].set(0)
-        E10 = jnp.full(W, inf, jnp.int32).at[0].set(-oe1)
-        E20 = jnp.full(W, inf, jnp.int32).at[0].set(-oe2) if convex \
-            else jnp.full(W, inf, jnp.int32)
+        E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+        E20 = jnp.full(W, inf, dt).at[0].set(-oe2) if convex \
+            else jnp.full(W, inf, dt)
 
-    Hb = jnp.full((R, W), inf, jnp.int32).at[0].set(H0)
-    E1b = jnp.full((R, W), inf, jnp.int32).at[0].set(E10)
-    E2b = jnp.full((R, W), inf, jnp.int32).at[0].set(E20)
-    F1b = jnp.full((R, W), inf, jnp.int32).at[0].set(F10)
-    F2b = jnp.full((R, W), inf, jnp.int32).at[0].set(F20)
+    Hb = jnp.full((R, W), inf, dt).at[0].set(H0)
+    E1b = jnp.full((R, W), inf, dt).at[0].set(E10)
+    E2b = jnp.full((R, W), inf, dt).at[0].set(E20)
+    F1b = jnp.full((R, W), inf, dt).at[0].set(F10)
+    F2b = jnp.full((R, W), inf, dt).at[0].set(F20)
     dp_beg = jnp.zeros(R, jnp.int32)
     dp_end = jnp.zeros(R, jnp.int32).at[0].set(dp_end0)
     mpl = jnp.concatenate([mpl0, jnp.zeros(1, jnp.int32)])
@@ -213,7 +220,7 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         F = A
         shift = 1
         for _ in range(n_chain_steps):
-            prev = jnp.concatenate([jnp.full(shift, inf, jnp.int32), F[:-shift]])
+            prev = jnp.concatenate([jnp.full(shift, inf, dt), F[:-shift]])
             shifted = jnp.maximum(prev, inf + shift * ext) - shift * ext
             F = jnp.maximum(F, shifted)
             shift <<= 1
@@ -272,9 +279,9 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         if linear:
             Hrow = chain_max(Hhat, e1)
             Hrow = jnp.where(in_band, Hrow, inf)
-            E1n = E2n = F1n = F2n = jnp.full(W, inf, jnp.int32)
+            E1n = E2n = F1n = F2n = jnp.full(W, inf, dt)
         else:
-            Hm1w = jnp.concatenate([jnp.full(1, inf, jnp.int32), Hhat[:-1]])
+            Hm1w = jnp.concatenate([jnp.full(1, inf, dt), Hhat[:-1]])
             A1 = jnp.where(kw == 0, Mq - oe1, Hm1w - oe1)
             A1 = jnp.where(in_band, A1, inf)
             F1n = chain_max(A1, e1)
@@ -285,11 +292,11 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 F2n = chain_max(A2, e2)
                 Hrow = jnp.maximum(Hrow, F2n)
             else:
-                F2n = jnp.full(W, inf, jnp.int32)
+                F2n = jnp.full(W, inf, dt)
             if gap_mode == C.AFFINE_GAP:
                 E1n = jnp.maximum(Erow - e1, Hrow - oe1)
                 E1n = jnp.where(Hrow == Hhat, E1n, inf)
-                E2n = jnp.full(W, inf, jnp.int32)
+                E2n = jnp.full(W, inf, dt)
             else:
                 E1n = jnp.maximum(Erow - e1, Hrow - oe1)
                 E2n = jnp.maximum(E2row - e2, Hrow - oe2)
@@ -354,6 +361,10 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
     exactly their full-width value. Op priority chain replicates
     /root/reference/src/abpoa_align_simd.c:309-458.
     """
+    dt = H.dtype
+    mat = mat.astype(dt)
+    e1, oe1, e2, oe2 = [x.astype(dt) for x in (e1, oe1, e2, oe2)]
+    inf_min = inf_min.astype(dt)
     R, W = H.shape
     P = pre_idx.shape[1]
     linear = gap_mode == C.LINEAR_GAP
@@ -828,13 +839,16 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
                       kahn_runs=state.kahn_runs)
 
 
-@functools.partial(jax.jit, static_argnames=("gap_mode", "W", "max_ops",
-                                             "gap_on_right", "put_gap_at_end"))
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
+    "max_mat", "int16_limit"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
                     gap_mode: int, W: int, max_ops: int,
-                    gap_on_right: bool, put_gap_at_end: bool) -> FusedState:
+                    gap_on_right: bool, put_gap_at_end: bool,
+                    plane16: bool = False, max_mat: int = 0,
+                    int16_limit: int = 0) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -857,6 +871,15 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             n = g.node_n
             # capacity pre-check: a read can add at most qlen+1 nodes
             over_cap = n + qlen + 1 > N
+            if plane16:
+                # score-width promotion bound (abpoa_align_simd.c:1293-1302):
+                # once the graph (or query) outgrows the int16 budget, exit so
+                # the host re-enters with int32 planes
+                ln = jnp.maximum(qlen, n)
+                max_score = jnp.maximum(qlen * max_mat, ln * e1 + o1)
+                need_promote = max_score > int16_limit
+            else:
+                need_promote = jnp.bool_(False)
 
             (base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
              remain_rows, mpl0, mpr0) = _build_tables(g, order, n2i, remain)
@@ -872,7 +895,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                 base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 remain_rows, mpl0, mpr0, qp, n,
                 qlen, w, remain_end, inf_min, dp_end0,
-                o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W)
+                o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
+                plane16=plane16)
 
             # global best over the sink's predecessor rows at their band ends
             sink_rows = pre_idx[n - 1]
@@ -951,12 +975,13 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             need_kahn = bad | collision
             g3, order3, n2i3, remain3 = lax.cond(need_kahn, kahn, splice_ok, None)
 
-            err = jnp.where(over_cap | (g2.node_n + 2 > N), ERR_NODE_CAP,
+            err = jnp.where(need_promote, ERR_PROMOTE,
+                  jnp.where(over_cap | (g2.node_n + 2 > N), ERR_NODE_CAP,
                   jnp.where(overflow, ERR_BAND_CAP,
                   jnp.where(edge_cap, ERR_EDGE_CAP,
                   jnp.where(grp_full, ERR_ALIGN_CAP,
                   jnp.where(bt_err, ERR_BACKTRACK,
-                  jnp.where(ops_cap, ERR_OPS_CAP, ERR_OK)))))).astype(jnp.int32)
+                  jnp.where(ops_cap, ERR_OPS_CAP, ERR_OK))))))).astype(jnp.int32)
             # capacity overflow inside the sequential fallbacks (fuse_alignment
             # / topo_sort set only a boolean ok) has no dimension attached
             err = jnp.where((err == ERR_OK) & ~g3.ok,
@@ -1044,7 +1069,6 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     N = _bucket(2 * (qmax + 2) + 64, 1024)
     E = 8
     A = 8
-    inf_min = dp_inf_min(abpt)
 
     seqs_pad = np.zeros((n_reads, Qp), dtype=np.int32)
     wgts_pad = np.ones((n_reads, Qp), dtype=np.int32)
@@ -1065,10 +1089,17 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     qp_d = jnp.asarray(qp_all)
     mat_d = jnp.asarray(mat)
 
+    # int16 planes while the promotion bound allows (checked per read on
+    # device; ERR_PROMOTE flips to int32 once the graph outgrows the budget)
+    int16_limit = int16_score_limit(abpt)
+    plane16 = max(qmax * abpt.max_mat,
+                  qmax * abpt.gap_ext1 + abpt.gap_open1) <= int16_limit
+
     state = init_fused_state(N, E, A)
     kahn_total = 0
     for _ in range(max_chunks):
         max_ops = N + Qp + 8
+        inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
         state = run_fused_chunk(
             state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
             qp_d, mat_d, jnp.int32(abpt.wb), jnp.float32(abpt.wf),
@@ -1078,12 +1109,17 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
             gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
             gap_on_right=bool(abpt.put_gap_on_right),
-            put_gap_at_end=bool(abpt.put_gap_at_end))
+            put_gap_at_end=bool(abpt.put_gap_at_end),
+            plane16=plane16, max_mat=int(abpt.max_mat),
+            int16_limit=int(int16_limit))
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:
             break
-        if err in (ERR_NODE_CAP, ERR_OPS_CAP):
+        if err == ERR_PROMOTE:
+            plane16 = False
+            state = state._replace(err=jnp.int32(ERR_OK))
+        elif err in (ERR_NODE_CAP, ERR_OPS_CAP):
             N = _bucket(int(N * 1.7), 1024)
             state = _grow_state(state, N, E, A)
         elif err == ERR_BAND_CAP:

@@ -43,13 +43,17 @@ def dp_inf_min(abpt: Params, dtype_min: int = INT32_MIN) -> int:
             + 512 * max(abpt.gap_ext1, abpt.gap_ext2))
 
 
+def int16_score_limit(abpt: Params) -> int:
+    """Largest worst-case score that still fits 16-bit lanes
+    (abpoa_align_simd.c:1284-1302)."""
+    return INT16_MAX - abpt.min_mis - abpt.gap_oe1 - abpt.gap_oe2
+
+
 def _select_dtype(abpt: Params, qlen: int, gn: int) -> Tuple[np.dtype, int]:
     """Score width promotion (abpoa_align_simd.c:1284-1302)."""
-    ge1 = abpt.gap_ext1
-    oe1, oe2 = abpt.gap_oe1, abpt.gap_oe2
     ln = max(qlen, gn)
-    max_score = max(qlen * abpt.max_mat, ln * ge1 + abpt.gap_open1)
-    if max_score <= INT16_MAX - abpt.min_mis - oe1 - oe2:
+    max_score = max(qlen * abpt.max_mat, ln * abpt.gap_ext1 + abpt.gap_open1)
+    if max_score <= int16_score_limit(abpt):
         return np.dtype(np.int16), dp_inf_min(abpt, INT16_MIN)
     return np.dtype(np.int32), dp_inf_min(abpt, INT32_MIN)
 

@@ -72,10 +72,34 @@ def _time_run(device, path, warm=False):
     return time.time() - t0
 
 
+# wall-clock caps for accelerator runs: a slow/hung device path must not
+# stall the bench — the native number still gets reported
+_JAX_TIMEOUT = {"sim2k": 900, "sim10k_500": 2400}
+
+
+def _time_run_subprocess(device, path, warm, timeout):
+    """Time a run in a subprocess with a hard timeout (device paths only)."""
+    code = (
+        "import sys; sys.path.insert(0, {here!r})\n"
+        "import bench\n"
+        "print('WALL', bench._time_run({device!r}, {path!r}, warm={warm}))\n"
+    ).format(here=HERE, device=device, path=path, warm=warm)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout)
+    for line in proc.stdout.splitlines():
+        if line.startswith("WALL "):
+            return float(line.split()[1])
+    raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
+
+
 def _run_workload(key, path, n_reads, devices, warm, per_backend, results):
     for device in devices:
         try:
-            wall = _time_run(device, path, warm=warm)
+            if device == "jax":
+                wall = _time_run_subprocess(device, path, warm,
+                                            _JAX_TIMEOUT.get(key, 900))
+            else:
+                wall = _time_run(device, path, warm=warm)
         except Exception as e:
             print(f"[bench] {device} {key} failed: {e}", file=sys.stderr)
             continue
