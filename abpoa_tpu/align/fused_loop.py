@@ -66,7 +66,8 @@ from .. import constants as C
 from ..params import Params
 from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
 from .jax_backend import _bucket, _bucket_pow2
-from .oracle import INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit
+from .oracle import (INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit,
+                     max_score_bound)
 
 # error codes reported by the fused loop (state.err)
 ERR_OK = 0
@@ -872,9 +873,9 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             # capacity pre-check: a read can add at most qlen+1 nodes
             over_cap = n + qlen + 1 > N
             if plane16:
-                # score-width promotion bound (abpoa_align_simd.c:1293-1302):
-                # once the graph (or query) outgrows the int16 budget, exit so
-                # the host re-enters with int32 planes
+                # score-width promotion bound: traced twin of
+                # oracle.max_score_bound — once the graph (or query) outgrows
+                # the int16 budget, exit so the host re-enters with int32
                 ln = jnp.maximum(qlen, n)
                 max_score = jnp.maximum(qlen * max_mat, ln * e1 + o1)
                 need_promote = max_score > int16_limit
@@ -1092,8 +1093,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     # int16 planes while the promotion bound allows (checked per read on
     # device; ERR_PROMOTE flips to int32 once the graph outgrows the budget)
     int16_limit = int16_score_limit(abpt)
-    plane16 = max(qmax * abpt.max_mat,
-                  qmax * abpt.gap_ext1 + abpt.gap_open1) <= int16_limit
+    plane16 = max_score_bound(abpt, qmax, 2) <= int16_limit
 
     state = init_fused_state(N, E, A)
     kahn_total = 0

@@ -56,15 +56,26 @@ def _bucket_pow2(n: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("gap_mode", "local", "banded", "n_steps"))
+    static_argnames=("gap_mode", "local", "banded", "n_steps", "extend",
+                     "zdrop_on"))
 def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
              remain_rows, mpl0, mpr0, qp,
              qlen, w, remain_end, inf_min, dp_end0,
              o1, e1, oe1, o2, e2, oe2,
-             gap_mode: int, local: bool, banded: bool, n_steps: int):
+             gap_mode: int, local: bool, banded: bool, n_steps: int,
+             extend: bool = False, zdrop_on: bool = False,
+             pre_score=None, zdrop=0):
     """Scan the DP over graph rows. Returns (H, E1, E2, F1, F2, dp_beg, dp_end,
-    mpl, mpr)."""
+    mpl, mpr, row_max, row_left, row_right, best_score, best_i, best_j).
+
+    pre_score[(R, P)] holds the -G log-scaled path score per predecessor slot
+    (reference abpoa_graph.c:429-437); zeros when inc_path_score is off.
+    extend-mode best tracking (with optional Z-drop,
+    abpoa_align_simd.c:1076-1090) runs in the scan carry so the sequential
+    best-so-far/stop semantics match the reference exactly."""
     R, P = pre_idx.shape
+    if pre_score is None:
+        pre_score = jnp.zeros((R, P), jnp.int32)
     Qp = qp.shape[1]
     cols = jnp.arange(Qp, dtype=jnp.int32)
     inf = inf_min
@@ -129,10 +140,12 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
         return F
 
     def body(carry, i):
-        Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr = carry
+        (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+         bs, bi, bj, brem, zdropped) = carry
         active = row_active[i]
         pm = pre_msk[i]
         pidx = pre_idx[i]
+        ps = pre_score[i]
 
         # ---- band ----------------------------------------------------------
         if banded:
@@ -151,14 +164,17 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
         Hpre = Hb[pidx]                      # (P, Qp)
         shifted = jnp.concatenate(
             [jnp.full((P, 1), lead, jnp.int32), Hpre[:, :-1]], axis=1)
-        shifted = jnp.where(pm[:, None], shifted, inf)
+        shifted = jnp.where(pm[:, None], shifted + ps[:, None], inf)
         Mq = jnp.max(shifted, axis=0)
         if linear:
-            Erow = jnp.max(jnp.where(pm[:, None], Hpre - e1, inf), axis=0)
+            Erow = jnp.max(jnp.where(pm[:, None], Hpre - e1 + ps[:, None], inf),
+                           axis=0)
         else:
-            Erow = jnp.max(jnp.where(pm[:, None], E1b[pidx], inf), axis=0)
+            Erow = jnp.max(jnp.where(pm[:, None], E1b[pidx] + ps[:, None], inf),
+                           axis=0)
             if convex:
-                E2row = jnp.max(jnp.where(pm[:, None], E2b[pidx], inf), axis=0)
+                E2row = jnp.max(jnp.where(pm[:, None], E2b[pidx] + ps[:, None],
+                                          inf), axis=0)
 
         Mq = Mq + qp[base[i]]
         Mq = jnp.where(in_band, Mq, inf)
@@ -214,8 +230,25 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
         eq = (vals == mx) & in_band
         left = jnp.where(has, jnp.argmax(eq), -1).astype(jnp.int32)
         right = jnp.where(has, Qp - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
+        if extend:
+            has_row = mx > inf
+            better = active & (~zdropped) & (mx > bs)
+            if zdrop_on:
+                delta = brem - remain_rows[i]
+                # empty-band rows (mx == -inf) Z-drop whenever any real best
+                # exists (the oracle's Python-int arithmetic, oracle.py:336);
+                # splitting the case avoids int32 wrap in bs - mx
+                zd_real = has_row & \
+                    (bs - mx > zdrop + e1 * jnp.abs(delta - (right - bj)))
+                zd = active & (~zdropped) & (~better) & \
+                    (zd_real | ((~has_row) & (bs > inf)))
+                zdropped = zdropped | zd
+            bs = jnp.where(better, mx, bs)
+            bi = jnp.where(better, i, bi)
+            bj = jnp.where(better, right, bj)
+            brem = jnp.where(better, remain_rows[i], brem)
         if banded:
-            om = out_msk[i] & active
+            om = out_msk[i] & active & (~zdropped)
             tgt = jnp.where(om, out_idx[i], R)
             mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
             mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
@@ -231,29 +264,28 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 F2b = F2b.at[i].set(jnp.where(keep, F2n, F2b[i]))
         dp_beg = dp_beg.at[i].set(jnp.where(keep, beg, dp_beg[i]))
         dp_end = dp_end.at[i].set(jnp.where(keep, end, dp_end[i]))
-        return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr), \
+        return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                bs, bi, bj, brem, zdropped), \
             (jnp.where(keep, mx, inf), jnp.where(keep, left, -1),
              jnp.where(keep, right, -1))
 
-    carry = (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr)
+    carry = (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+             inf, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
     carry, rows = lax.scan(body, carry, jnp.arange(1, n_steps + 1, dtype=jnp.int32))
-    Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr = carry
+    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+     bs, bi, bj, _brem, _zd) = carry
     row_max, row_left, row_right = rows
     return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1],
-            row_max, row_left, row_right)
+            row_max, row_left, row_right, bs, bi, bj)
 
 
 def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
                                    end_node_id: int, query: np.ndarray) -> AlignResult:
-    # unsupported corners fall back to the oracle
-    if abpt.inc_path_score or (abpt.align_mode == C.EXTEND_MODE and abpt.zdrop > 0):
-        from .oracle import align_sequence_to_subgraph_numpy
-        return align_sequence_to_subgraph_numpy(g, abpt, beg_node_id, end_node_id, query)
-
     res = AlignResult()
     qlen = len(query)
     local = abpt.align_mode == C.LOCAL_MODE
     extend = abpt.align_mode == C.EXTEND_MODE
+    zdrop_on = extend and abpt.zdrop > 0
     banded = abpt.wb >= 0
     w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
     inf_min = dp_inf_min(abpt)
@@ -269,6 +301,7 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
             t["out_idx"], t["out_msk"], t["remain_rows"], t["mpl0"], t["mpr0"])
         gn, R, beg_index, remain_end = t["gn"], t["R"], t["beg_index"], t["remain_end"]
         idx2nid = g.index_to_node_id
+        pre_score = None  # native graphs are never used with -G (_want_native)
         if banded:
             r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
             dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)
@@ -287,6 +320,7 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
         max_p = 1
         max_o = 1
         pre_lists = []
+        slot_lists = []
         out_lists = []
         for i in range(gn):
             nid = int(idx2nid[beg_index + i])
@@ -294,11 +328,17 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
             row_active[i] = bool(index_map[beg_index + i])
             if i == 0 or not row_active[i]:
                 pre_lists.append([])
+                slot_lists.append([])
                 out_lists.append([])
                 continue
-            pl = [int(g.node_id_to_index[p]) - beg_index for p in nodes[nid].in_ids
-                  if index_map[int(g.node_id_to_index[p])]]
+            pl = []
+            slots = []
+            for k_in, p in enumerate(nodes[nid].in_ids):
+                if index_map[int(g.node_id_to_index[p])]:
+                    pl.append(int(g.node_id_to_index[p]) - beg_index)
+                    slots.append(k_in)
             pre_lists.append(pl)
+            slot_lists.append(slots)
             if banded and i < gn - 1:
                 ol = [int(g.node_id_to_index[o]) - beg_index for o in nodes[nid].out_ids]
                 out_lists.append(ol)
@@ -312,10 +352,15 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
         pre_msk = np.zeros((R, P), dtype=bool)
         out_idx = np.zeros((R, O), dtype=np.int32)
         out_msk = np.zeros((R, O), dtype=bool)
+        pre_score = np.zeros((R, P), dtype=np.int32) if abpt.inc_path_score else None
         for i in range(gn):
             pl = pre_lists[i]
             pre_idx[i, : len(pl)] = pl
             pre_msk[i, : len(pl)] = True
+            if pre_score is not None and pl:
+                nid = int(idx2nid[beg_index + i])
+                pre_score[i, : len(pl)] = [
+                    g.incre_path_score(nid, k_in) for k_in in slot_lists[i]]
             ol = out_lists[i]
             out_idx[i, : len(ol)] = ol
             out_msk[i, : len(ol)] = True
@@ -327,6 +372,12 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
         mpl0 = np.zeros(R, dtype=np.int32)
         mpr0 = np.zeros(R, dtype=np.int32)
         remain_end = 0
+        if zdrop_on and not banded:
+            # Z-drop needs max_remain even without banding (oracle.py:126)
+            remain = g.node_id_to_max_remain
+            for i in range(gn):
+                remain_rows[i] = remain[int(idx2nid[beg_index + i])]
+            remain_end = int(remain[end_node_id])
         if banded:
             remain = g.node_id_to_max_remain
             mpl_g = g.node_id_to_max_pos_left
@@ -377,7 +428,9 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
         gap_mode=abpt.gap_mode, local=local, banded=banded, n_steps=R - 1,
         align_mode=abpt.align_mode, gap_on_right=bool(abpt.put_gap_on_right),
         put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
-        ret_cigar=bool(abpt.ret_cigar))
+        ret_cigar=bool(abpt.ret_cigar), zdrop_on=zdrop_on,
+        pre_score=None if pre_score is None else jnp.asarray(pre_score),
+        zdrop=jnp.int32(max(abpt.zdrop, 0)))
     packed = np.asarray(packed)  # ONE device->host transfer
 
     # unpack: [n_ops, i, j, n_aln, n_match, si, sj, err, best_score, best_i,
@@ -436,24 +489,27 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "local", "banded", "n_steps", "align_mode", "gap_on_right",
-    "put_gap_at_end", "max_ops", "ret_cigar"))
+    "put_gap_at_end", "max_ops", "ret_cigar", "zdrop_on"))
 def _dp_full(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
              remain_rows, mpl0, mpr0, qp, query_pad, mat, sink_rows, sink_msk,
              qlen, w, remain_end, inf_min, dp_end0,
              o1, e1, oe1, o2, e2, oe2,
              gap_mode: int, local: bool, banded: bool, n_steps: int,
              align_mode: int, gap_on_right: bool, put_gap_at_end: bool,
-             max_ops: int, ret_cigar: bool):
+             max_ops: int, ret_cigar: bool,
+             zdrop_on: bool = False, pre_score=None, zdrop=0):
     """DP scan + best selection + device backtrack, one packed int32 output."""
     from .jax_backtrack import device_backtrack
 
+    extend = align_mode == C.EXTEND_MODE
     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-     row_max, row_left, row_right) = _dp_scan(
+     row_max, row_left, row_right, bs, bi, bj) = _dp_scan(
         base, pre_idx, pre_msk, out_idx, out_msk, row_active,
         remain_rows, mpl0, mpr0, qp,
         qlen, w, remain_end, inf_min, dp_end0,
         o1, e1, oe1, o2, e2, oe2,
-        gap_mode=gap_mode, local=local, banded=banded, n_steps=n_steps)
+        gap_mode=gap_mode, local=local, banded=banded, n_steps=n_steps,
+        extend=extend, zdrop_on=zdrop_on, pre_score=pre_score, zdrop=zdrop)
 
     if align_mode == C.GLOBAL_MODE:
         ends = jnp.minimum(qlen, dp_end[sink_rows])
@@ -462,12 +518,14 @@ def _dp_full(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
         best_score = vals[k]
         best_i = sink_rows[k]
         best_j = ends[k]
+    elif align_mode == C.EXTEND_MODE:
+        # best-so-far carried in the scan (required for Z-drop stop semantics)
+        best_score, best_i, best_j = bs, bi, bj
     else:
         k = jnp.argmax(row_max)  # first row achieving the max
         best_score = row_max[k]
         best_i = (k + 1).astype(jnp.int32)
-        best_j = (row_right[k] if align_mode == C.EXTEND_MODE
-                  else row_left[k]).astype(jnp.int32)
+        best_j = row_left[k].astype(jnp.int32)
 
     if ret_cigar:
         ops, n_ops, fi, fj, n_aln, n_match, si, sj, err = device_backtrack(
@@ -475,7 +533,8 @@ def _dp_full(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
             base, query_pad, mat, best_i, best_j,
             e1, oe1, e2, oe2,
             gap_mode=gap_mode, local=local, gap_on_right=gap_on_right,
-            put_gap_at_end=put_gap_at_end, max_ops=max_ops)
+            put_gap_at_end=put_gap_at_end, max_ops=max_ops,
+            pre_score=pre_score)
     else:
         ops = jnp.zeros((max_ops, 2), jnp.int32)
         n_ops = fi = fj = n_aln = n_match = si = sj = jnp.int32(0)

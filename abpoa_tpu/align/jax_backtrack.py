@@ -30,10 +30,15 @@ def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
                      base, query_pad, mat, best_i, best_j,
                      e1, oe1, e2, oe2,
                      gap_mode: int, local: bool, gap_on_right: bool,
-                     put_gap_at_end: bool, max_ops: int):
+                     put_gap_at_end: bool, max_ops: int, pre_score=None):
     """Returns (ops[max_ops, 2], n_ops, final_i, final_j, n_aln, n_match,
-    start_i, start_j). ops rows: (op_code, dp_i-at-emit)."""
+    start_i, start_j). ops rows: (op_code, dp_i-at-emit).
+
+    pre_score: per-(row, pred-slot) -G path score (abpoa_graph.c:429-437),
+    added to every predecessor-crossing score equality."""
     R, P = pre_idx.shape
+    if pre_score is None:
+        pre_score = jnp.zeros((R, P), jnp.int32)
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     i32 = jnp.int32
@@ -65,6 +70,7 @@ def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
 
         pidx = pre_idx[i]
         pmsk = pre_msk[i]
+        ps = pre_score[i]
         Hp_jm1 = H[pidx, j - 1]
         Hp_j = H[pidx, j]
         beg_p = dp_beg[pidx]
@@ -72,7 +78,7 @@ def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
         inb_m = (j - 1 >= beg_p) & (j - 1 <= end_p) & pmsk
         inb_e = (j >= beg_p) & (j <= end_p) & pmsk
 
-        m_hit = inb_m & (Hp_jm1 + s == H_ij)
+        m_hit = inb_m & (Hp_jm1 + s + ps == H_ij)
         any_m = jnp.any(m_hit)
         first_m = jnp.argmax(m_hit).astype(i32)
 
@@ -87,7 +93,7 @@ def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
 
         # ---------- deletion ----------
         if linear:
-            d_hit = inb_e & (Hp_j - e1 == H_ij)
+            d_hit = inb_e & (Hp_j - e1 + ps == H_ij)
             any_d = jnp.any(d_hit)
             first_d = jnp.argmax(d_hit).astype(i32)
             d_new_op = jnp.int32(C.ALL_OP)
@@ -95,13 +101,13 @@ def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
             E1_ij = gat(E1, i, j)
             E1p_j = E1[pidx, j]
             has_E1 = (cur_op & C.E1_OP) != 0
-            c1 = jnp.where(has_M, H_ij == E1p_j, E1_ij == E1p_j - e1)
+            c1 = jnp.where(has_M, H_ij == E1p_j + ps, E1_ij == E1p_j - e1 + ps)
             hit1 = inb_e & c1 & has_E1
             if convex:
                 E2_ij = gat(E2, i, j)
                 E2p_j = E2[pidx, j]
                 has_E2 = (cur_op & C.E2_OP) != 0
-                c2 = jnp.where(has_M, H_ij == E2p_j, E2_ij == E2p_j - e2)
+                c2 = jnp.where(has_M, H_ij == E2p_j + ps, E2_ij == E2p_j - e2 + ps)
                 hit2 = inb_e & c2 & has_E2
             else:
                 hit2 = jnp.zeros_like(hit1)

@@ -49,10 +49,18 @@ def int16_score_limit(abpt: Params) -> int:
     return INT16_MAX - abpt.min_mis - abpt.gap_oe1 - abpt.gap_oe2
 
 
+def max_score_bound(abpt: Params, qlen: int, gn: int) -> int:
+    """Worst-case alignment score used for width selection
+    (abpoa_align_simd.c:1293-1302). The fused loop's on-device promote check
+    (fused_loop.run_fused_chunk) evaluates the same formula with traced
+    values; keep them in sync."""
+    ln = max(qlen, gn)
+    return max(qlen * abpt.max_mat, ln * abpt.gap_ext1 + abpt.gap_open1)
+
+
 def _select_dtype(abpt: Params, qlen: int, gn: int) -> Tuple[np.dtype, int]:
     """Score width promotion (abpoa_align_simd.c:1284-1302)."""
-    ln = max(qlen, gn)
-    max_score = max(qlen * abpt.max_mat, ln * abpt.gap_ext1 + abpt.gap_open1)
+    max_score = max_score_bound(abpt, qlen, gn)
     if max_score <= int16_score_limit(abpt):
         return np.dtype(np.int16), dp_inf_min(abpt, INT16_MIN)
     return np.dtype(np.int32), dp_inf_min(abpt, INT32_MIN)

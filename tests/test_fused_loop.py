@@ -76,6 +76,20 @@ def test_fused_sim2k_with_growth_and_kahn():
     assert got == want
 
 
+def test_fused_int16_promotion_boundary(monkeypatch):
+    """Mid-run int16 -> int32 promotion (ERR_PROMOTE) must hand off with no
+    lost or duplicated reads. A lowered synthetic score limit makes the graph
+    cross the bound after a few short reads instead of needing ~16k nodes."""
+    import abpoa_tpu.align.fused_loop as fl
+    # seq.fa: ~51bp reads (initial bound 106 <= 160 -> starts int16); the
+    # graph grows to 89 nodes, crossing ln*e1+o1 > 160 at gn > 78 mid-run
+    monkeypatch.setattr(fl, "int16_score_limit", lambda abpt: 160)
+    path = os.path.join(DATA_DIR, "seq.fa")
+    got, _ = _consensus_via_fused(path)
+    want = _consensus_via_host(path)
+    assert got == want
+
+
 def test_fused_pipeline_wiring():
     """device=jax routes the plain progressive loop through the fused path."""
     path = os.path.join(DATA_DIR, "seq.fa")

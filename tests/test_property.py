@@ -80,3 +80,51 @@ def test_jax_matches_oracle(mode, gap, wb):
     cons_np = _run(mk("numpy"), reads)
     cons_jx = _run(mk("jax"), reads)
     assert cons_np == cons_jx
+
+
+EXTRA_CASES = [
+    # extend + Z-drop (abpoa_align_simd.c:1076-1090), banded and unbanded
+    (C.EXTEND_MODE, C.CONVEX_GAP, 10, {"zdrop": 20}),
+    (C.EXTEND_MODE, C.CONVEX_GAP, -1, {"zdrop": 15}),
+    (C.EXTEND_MODE, C.AFFINE_GAP, 10, {"zdrop": 25}),
+    # -G log-scaled path scores (abpoa_graph.c:429-437)
+    (C.GLOBAL_MODE, C.CONVEX_GAP, 10, {"inc_path_score": True}),
+    (C.GLOBAL_MODE, C.LINEAR_GAP, 10, {"inc_path_score": True}),
+    (C.EXTEND_MODE, C.CONVEX_GAP, 10, {"inc_path_score": True, "zdrop": 20}),
+]
+
+
+@pytest.mark.parametrize("mode,gap,wb,extra", EXTRA_CASES,
+                         ids=[f"m{m}-g{g}-b{b}-" + "-".join(e)
+                              for m, g, b, e in EXTRA_CASES])
+def test_jax_matches_oracle_zdrop_pathscore(mode, gap, wb, extra):
+    """The device kernel must cover -G and extend+Z-drop natively (no oracle
+    fallback; VERDICT round-1 item 6)."""
+    rng = np.random.default_rng(mode * 100 + gap * 10 + wb + 7)
+    reads = _random_reads(rng, 6, 150)
+
+    def mk(device):
+        abpt = Params()
+        abpt.align_mode = mode
+        abpt.wb = wb
+        if gap == C.LINEAR_GAP:
+            abpt.gap_open1 = abpt.gap_open2 = 0
+        elif gap == C.AFFINE_GAP:
+            abpt.gap_open2 = 0
+        for k, v in extra.items():
+            setattr(abpt, k, v)
+        abpt.device = device
+        return abpt.finalize()
+
+    cons_np = _run(mk("numpy"), reads)
+    import abpoa_tpu.align.oracle as oracle_mod
+    calls = {"n": 0}
+    orig = oracle_mod.align_sequence_to_subgraph_numpy
+    oracle_mod.align_sequence_to_subgraph_numpy = (
+        lambda *a, **k: (calls.__setitem__("n", calls["n"] + 1), orig(*a, **k))[1])
+    try:
+        cons_jx = _run(mk("jax"), reads)
+    finally:
+        oracle_mod.align_sequence_to_subgraph_numpy = orig
+    assert cons_np == cons_jx
+    assert calls["n"] == 0, "jax path silently fell back to the oracle"
