@@ -90,6 +90,23 @@ def test_fused_int16_promotion_boundary(monkeypatch):
     assert got == want
 
 
+def test_fused_scale_long_reads(tmp_path):
+    """Scale parity (VERDICT round-1 item 8): a 40-read x 4 kb ONT-like set
+    drives the graph through multiple capacity-growth buckets (final ~12.5k
+    nodes), int16 planes throughout, and repeated Kahn order repairs; the
+    consensus must stay byte-identical to the native engine."""
+    import subprocess
+    path = str(tmp_path / "sim4k_40.fa")
+    subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "make_sim.py"),
+         "--ref-len", "4000", "--n-reads", "40", "--err", "0.1",
+         "--seed", "5", "--out", path], check=True)
+    got, kahn = _consensus_via_fused(path)
+    want = _consensus_via_host(path, device="native")
+    assert got == want
+    assert kahn > 0  # the repair path must actually have been exercised
+
+
 def test_fused_pipeline_wiring():
     """device=jax routes the plain progressive loop through the fused path."""
     path = os.path.join(DATA_DIR, "seq.fa")
