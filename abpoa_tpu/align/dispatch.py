@@ -55,5 +55,26 @@ def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
     return _resolve(abpt)(g, abpt, beg_node_id, end_node_id, query)
 
 
+def align_windows(g: POAGraph, abpt: Params, windows) -> list:
+    """Align independent subgraph windows [(beg_id, end_id, query), ...].
+
+    Device backends batch all windows into one dispatch
+    (jax_backend.align_windows_jax); host backends run them sequentially.
+    Results are identical either way.
+    """
+    if not windows:
+        return []
+    if g.node_n <= 2:
+        return [AlignResult() for _ in windows]
+    if not g.is_topological_sorted:
+        g.topological_sort(abpt)
+    _resolve(abpt)  # trigger lazy registration so the check below is accurate
+    if len(windows) > 1 and abpt.device in ("jax", "tpu", "pallas"):
+        from .jax_backend import align_windows_jax
+        return align_windows_jax(g, abpt, windows)
+    fn = _resolve(abpt)
+    return [fn(g, abpt, b, e, q) for b, e, q in windows]
+
+
 def align_sequence_to_graph(g: POAGraph, abpt: Params, query: np.ndarray) -> AlignResult:
     return align_sequence_to_subgraph(g, abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, query)

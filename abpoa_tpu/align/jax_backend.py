@@ -279,19 +279,22 @@ def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
             row_max, row_left, row_right, bs, bi, bj)
 
 
-def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
-                                   end_node_id: int, query: np.ndarray) -> AlignResult:
-    res = AlignResult()
+def _build_snapshot(g: POAGraph, abpt: Params, beg_node_id: int,
+                    end_node_id: int, query: np.ndarray) -> dict:
+    """Dense kernel tables for one subgraph alignment (per-window buckets).
+
+    Mirrors the reference's per-call setup (index_map BFS
+    abpoa_align_simd.c:1259-1269, band seeding :617-626). Mutates the graph's
+    band arrays exactly like the sequential path; windows of one read touch
+    disjoint index ranges, so batched builds commute with sequential ones.
+    """
     qlen = len(query)
-    local = abpt.align_mode == C.LOCAL_MODE
     extend = abpt.align_mode == C.EXTEND_MODE
     zdrop_on = extend and abpt.zdrop > 0
     banded = abpt.wb >= 0
     w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
-    inf_min = dp_inf_min(abpt)
     Qp = _bucket(qlen + 1, 128)
 
-    # ---- dense snapshot over the index window -------------------------------
     if getattr(g, "is_native", False):
         t = g.build_tables(beg_node_id, end_node_id, banded,
                            lambda n: _bucket(n, 64), _bucket_pow2)
@@ -300,7 +303,6 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
             t["base"], t["row_active"], t["pre_idx"], t["pre_msk"],
             t["out_idx"], t["out_msk"], t["remain_rows"], t["mpl0"], t["mpr0"])
         gn, R, beg_index, remain_end = t["gn"], t["R"], t["beg_index"], t["remain_end"]
-        idx2nid = g.index_to_node_id
         pre_score = None  # native graphs are never used with -G (_want_native)
         if banded:
             r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
@@ -413,28 +415,46 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
     sink_msk = np.zeros(SR, dtype=bool)
     sink_msk[: len(sink_rows)] = True
 
-    max_ops = R + Qp + 8
-    packed = _dp_full(
-        jnp.asarray(base), jnp.asarray(pre_idx), jnp.asarray(pre_msk),
-        jnp.asarray(out_idx), jnp.asarray(out_msk), jnp.asarray(row_active_scan),
-        jnp.asarray(remain_rows), jnp.asarray(mpl0), jnp.asarray(mpr0),
-        jnp.asarray(qp), jnp.asarray(query.astype(np.int32)),
-        jnp.asarray(np.ascontiguousarray(mat.astype(np.int32))),
-        jnp.asarray(sink_rows_a), jnp.asarray(sink_msk),
-        jnp.int32(qlen), jnp.int32(w), jnp.int32(remain_end), jnp.int32(inf_min),
-        jnp.int32(dp_end0),
-        jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1), jnp.int32(abpt.gap_oe1),
-        jnp.int32(abpt.gap_open2), jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
-        gap_mode=abpt.gap_mode, local=local, banded=banded, n_steps=R - 1,
-        align_mode=abpt.align_mode, gap_on_right=bool(abpt.put_gap_on_right),
-        put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
-        ret_cigar=bool(abpt.ret_cigar), zdrop_on=zdrop_on,
-        pre_score=None if pre_score is None else jnp.asarray(pre_score),
-        zdrop=jnp.int32(max(abpt.zdrop, 0)))
-    packed = np.asarray(packed)  # ONE device->host transfer
+    if pre_score is None:
+        pre_score = np.zeros_like(pre_idx)
+    return dict(base=base, pre_idx=pre_idx, pre_msk=pre_msk, out_idx=out_idx,
+                out_msk=out_msk, row_active=row_active_scan,
+                remain_rows=remain_rows, mpl0=mpl0, mpr0=mpr0, qp=qp,
+                query=query.astype(np.int32), pre_score=pre_score,
+                sink_rows=sink_rows_a, sink_msk=sink_msk,
+                qlen=qlen, w=w, remain_end=remain_end, dp_end0=dp_end0,
+                gn=gn, R=R, Qp=Qp, beg_index=beg_index)
 
-    # unpack: [n_ops, i, j, n_aln, n_match, si, sj, err, best_score, best_i,
-    #          best_j] + mpl(R) + mpr(R) + ops(max_ops*2)
+
+def _pad_snapshot(s: dict, R: int, P: int, O: int, Qp: int, SR: int) -> dict:
+    """Pad one snapshot's arrays to the batch's common bucket sizes; padding
+    rows/slots are masked off, so results are unchanged."""
+    def pad(a, shape):
+        out = np.zeros(shape, dtype=a.dtype)
+        out[tuple(slice(0, d) for d in a.shape)] = a
+        return out
+    return dict(
+        base=pad(s["base"], (R,)), pre_idx=pad(s["pre_idx"], (R, P)),
+        pre_msk=pad(s["pre_msk"], (R, P)), out_idx=pad(s["out_idx"], (R, O)),
+        out_msk=pad(s["out_msk"], (R, O)),
+        row_active=pad(s["row_active"], (R,)),
+        remain_rows=pad(s["remain_rows"], (R,)),
+        mpl0=pad(s["mpl0"], (R,)), mpr0=pad(s["mpr0"], (R,)),
+        qp=pad(s["qp"], (s["qp"].shape[0], Qp)),
+        query=pad(s["query"], (Qp,)), pre_score=pad(s["pre_score"], (R, P)),
+        sink_rows=pad(s["sink_rows"], (SR,)), sink_msk=pad(s["sink_msk"], (SR,)),
+        qlen=s["qlen"], w=s["w"], remain_end=s["remain_end"],
+        dp_end0=s["dp_end0"])
+
+
+def _result_from_packed(g: POAGraph, abpt: Params, packed: np.ndarray,
+                        snap: dict, R: int, max_ops: int) -> AlignResult:
+    """Unpack one window's device output: band write-back + cigar rebuild."""
+    res = AlignResult()
+    qlen = snap["qlen"]
+    gn, beg_index = snap["gn"], snap["beg_index"]
+    idx2nid = g.index_to_node_id
+    banded = abpt.wb >= 0
     (n_ops, fin_i, fin_j, n_aln, n_match, si, sj, err,
      best_score, best_i, best_j) = [int(x) for x in packed[:11]]
     off = 11
@@ -485,6 +505,86 @@ def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
     res.node_s = int(idx2nid[si + beg_index])
     res.query_s = sj - 1
     return res
+
+
+_ARRAY_KEYS = ("base", "pre_idx", "pre_msk", "out_idx", "out_msk",
+               "row_active", "remain_rows", "mpl0", "mpr0", "qp", "query",
+               "pre_score", "sink_rows", "sink_msk")
+_SCALAR_KEYS = ("qlen", "w", "remain_end", "dp_end0")
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "local", "banded", "n_steps", "align_mode", "gap_on_right",
+    "put_gap_at_end", "max_ops", "ret_cigar", "zdrop_on"))
+def _dp_full_batch(arrays, scalars, inf_min, scores, zdrop, **statics):
+    """vmap of _dp_full over the window axis: all windows of one seeded read
+    are independent alignments against the same frozen graph
+    (/root/reference/src/abpoa_align.c:209-310), so one dispatch covers them."""
+    o1, e1, oe1, o2, e2, oe2 = scores
+
+    def one(arr, sc):
+        return _dp_full(
+            arr["base"], arr["pre_idx"], arr["pre_msk"], arr["out_idx"],
+            arr["out_msk"], arr["row_active"], arr["remain_rows"],
+            arr["mpl0"], arr["mpr0"], arr["qp"], arr["query"], arr["mat"],
+            arr["sink_rows"], arr["sink_msk"],
+            sc["qlen"], sc["w"], sc["remain_end"], inf_min, sc["dp_end0"],
+            o1, e1, oe1, o2, e2, oe2,
+            pre_score=arr["pre_score"], zdrop=zdrop, **statics)
+
+    return jax.vmap(one, in_axes=({k: 0 for k in list(_ARRAY_KEYS) + ["mat"]},
+                                  {k: 0 for k in _SCALAR_KEYS}))(arrays, scalars)
+
+
+def align_windows_jax(g: POAGraph, abpt: Params,
+                      windows) -> list:
+    """Align a batch of independent subgraph windows in ONE device dispatch.
+
+    windows: list of (beg_node_id, end_node_id, query) tuples. Returns one
+    AlignResult per window, byte-identical to aligning them sequentially.
+    """
+    snaps = [_build_snapshot(g, abpt, b, e, q) for b, e, q in windows]
+    R = max(s["R"] for s in snaps)
+    Qp = max(s["Qp"] for s in snaps)
+    P = max(s["pre_idx"].shape[1] for s in snaps)
+    O = max(s["out_idx"].shape[1] for s in snaps)
+    SR = max(s["sink_rows"].shape[0] for s in snaps)
+    max_ops = R + Qp + 8
+    padded = [_pad_snapshot(s, R, P, O, Qp, SR) for s in snaps]
+    # bucket the batch dim like every other dim (bounds recompiles); dummy
+    # entries duplicate the last window and their outputs are discarded
+    B = _bucket_pow2(len(padded))
+    padded.extend(padded[-1:] * (B - len(padded)))
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+    arrays = {k: jnp.asarray(np.stack([p[k] for p in padded]))
+              for k in _ARRAY_KEYS}
+    arrays["mat"] = jnp.broadcast_to(jnp.asarray(mat),
+                                     (len(snaps),) + mat.shape)
+    scalars = {k: jnp.asarray(np.array([p[k] for p in padded], dtype=np.int32))
+               for k in _SCALAR_KEYS}
+    inf_min = dp_inf_min(abpt)
+    extend = abpt.align_mode == C.EXTEND_MODE
+    zdrop_on = extend and abpt.zdrop > 0
+
+    packed = _dp_full_batch(
+        arrays, scalars, jnp.int32(inf_min),
+        (jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+         jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+         jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2)),
+        jnp.int32(max(abpt.zdrop, 0)),
+        gap_mode=abpt.gap_mode, local=abpt.align_mode == C.LOCAL_MODE,
+        banded=abpt.wb >= 0, n_steps=R - 1, align_mode=abpt.align_mode,
+        gap_on_right=bool(abpt.put_gap_on_right),
+        put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
+        ret_cigar=bool(abpt.ret_cigar), zdrop_on=zdrop_on)
+    packed = np.asarray(packed)  # ONE device->host transfer for all windows
+    return [_result_from_packed(g, abpt, packed[i], snaps[i], R, max_ops)
+            for i in range(len(snaps))]
+
+
+def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
+                                   end_node_id: int, query: np.ndarray) -> AlignResult:
+    return align_windows_jax(g, abpt, [(beg_node_id, end_node_id, query)])[0]
 
 
 @functools.partial(jax.jit, static_argnames=(

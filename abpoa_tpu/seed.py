@@ -536,25 +536,34 @@ def anchor_poa(ab, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
             ab.is_rc[read_id] = False
             qseq, weight = seqs[i], weights[i]
 
+        # window specs are fully determined by the PREVIOUS read's graph
+        # (anchors + tpos map), so all of this read's windows are independent
+        # alignments against the frozen graph and can run as one device batch
+        # (/root/reference/src/abpoa_align.c:209-310)
+        specs = []          # (beg_id, end_id, beg_qpos, end_qpos)
+        kmer_runs = []      # anchor k-mer node ids between windows
         while ai < par_c[_i]:
             a = par_anchors[ai]
             end_tpos = ((a >> 32) & 0x7FFFFFFF) - k + 1
             end_id = int(tpos_to_node_id[end_tpos])
             end_qpos = (a & 0xFFFFFFFF) - k + 1
-            res = align_sequence_to_subgraph(g, abpt, beg_id, end_id,
-                                             qseq[beg_qpos:end_qpos])
-            whole_cigar.extend(res.cigar)
-            for j in range(k):  # exact-match cigar across the anchor k-mer
-                push_cigar(whole_cigar, C.CMATCH, 1,
-                           int(tpos_to_node_id[end_tpos + j]), j)
+            specs.append((beg_id, end_id, beg_qpos, end_qpos))
+            kmer_runs.append([int(tpos_to_node_id[end_tpos + j])
+                              for j in range(k)])
             beg_id = int(tpos_to_node_id[end_tpos + k - 1])
             beg_qpos = end_qpos + k
             ai += 1
-        end_id, end_qpos = C.SINK_NODE_ID, qlen
         if g.node_n > 2:
-            res = align_sequence_to_subgraph(g, abpt, beg_id, end_id,
-                                             qseq[beg_qpos:end_qpos])
+            specs.append((beg_id, C.SINK_NODE_ID, beg_qpos, qlen))
+
+        from .align.dispatch import align_windows
+        results = align_windows(
+            g, abpt, [(b, e, qseq[lo:hi]) for b, e, lo, hi in specs])
+        for wi, res in enumerate(results):
             whole_cigar.extend(res.cigar)
+            if wi < len(kmer_runs):
+                for j, nid in enumerate(kmer_runs[wi]):
+                    push_cigar(whole_cigar, C.CMATCH, 1, nid, j)
         g.add_subgraph_alignment(abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, qseq, weight,
                                  qpos_to_node_id, whole_cigar, read_id, tot_n_seq, True)
         tpos_to_node_id, qpos_to_node_id = qpos_to_node_id, tpos_to_node_id
