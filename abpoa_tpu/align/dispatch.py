@@ -68,11 +68,10 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
         return [AlignResult() for _ in windows]
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
-    _resolve(abpt)  # trigger lazy registration so the check below is accurate
+    fn = _resolve(abpt)  # also validates the backend name
     if len(windows) > 1 and abpt.device in ("jax", "tpu", "pallas"):
         from .jax_backend import align_windows_jax
         return align_windows_jax(g, abpt, windows)
-    fn = _resolve(abpt)
     return [fn(g, abpt, b, e, q) for b, e, q in windows]
 
 

@@ -158,6 +158,23 @@ def _remain_doubling(g: DeviceGraph) -> jnp.ndarray:
 # banded DP over graph rows                                                   #
 # --------------------------------------------------------------------------- #
 
+def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf):
+    """Row-0 (source row) plane windows for the convex-global regime
+    (abpoa_align_simd.c:582-688). Single source of truth — used by both
+    _dp_banded's init and the Pallas path. Dtype follows the scalars."""
+    dt = jnp.asarray(o1).dtype
+    kw = jnp.arange(W, dtype=jnp.int32)
+    kw_dt = kw.astype(dt)
+    colv = kw <= dp_end0
+    f1r = -o1 - e1 * kw_dt
+    f2r = -o2 - e2 * kw_dt
+    F10 = jnp.where(colv & (kw >= 1), f1r, inf)
+    F20 = jnp.where(colv & (kw >= 1), f2r, inf)
+    H0 = jnp.where(colv & (kw >= 1), jnp.maximum(f1r, f2r), inf).at[0].set(0)
+    E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+    E20 = jnp.full(W, inf, dt).at[0].set(-oe2)
+    return H0, E10, E20, F10, F20
+
 @functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16"))
 def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                remain_rows, mpl0, mpr0, qp, n_rows,
@@ -192,17 +209,16 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     if linear:
         H0 = jnp.where(colv, -e1 * kw_dt, inf)
         E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
+    elif convex:
+        H0, E10, E20, F10, F20 = _row0_planes(
+            W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf)
     else:
         f1r = -o1 - e1 * kw_dt
-        f2r = -o2 - e2 * kw_dt
         F10 = jnp.where(colv & (kw >= 1), f1r, inf)
-        F20 = jnp.where(colv & (kw >= 1), f2r, inf) if convex \
-            else jnp.full(W, inf, dt)
-        h0 = jnp.maximum(f1r, f2r) if convex else f1r
-        H0 = jnp.where(colv & (kw >= 1), h0, inf).at[0].set(0)
+        F20 = jnp.full(W, inf, dt)
+        H0 = jnp.where(colv & (kw >= 1), f1r, inf).at[0].set(0)
         E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
-        E20 = jnp.full(W, inf, dt).at[0].set(-oe2) if convex \
-            else jnp.full(W, inf, dt)
+        E20 = jnp.full(W, inf, dt)
 
     Hb = jnp.full((R, W), inf, dt).at[0].set(H0)
     E1b = jnp.full((R, W), inf, dt).at[0].set(E10)
@@ -842,14 +858,15 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
 
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
-    "max_mat", "int16_limit"))
+    "max_mat", "int16_limit", "use_pallas", "pl_interpret"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
                     gap_mode: int, W: int, max_ops: int,
                     gap_on_right: bool, put_gap_at_end: bool,
                     plane16: bool = False, max_mat: int = 0,
-                    int16_limit: int = 0) -> FusedState:
+                    int16_limit: int = 0, use_pallas: bool = False,
+                    pl_interpret: bool = False) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -891,13 +908,51 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w)
             qp = qp_mat[k]          # (m, Qp) profile of read k
 
-            (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-             overflow) = _dp_banded(
-                base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
-                remain_rows, mpl0, mpr0, qp, n,
-                qlen, w, remain_end, inf_min, dp_end0,
-                o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
-                plane16=plane16)
+            def dp_scan_path(_):
+                return _dp_banded(
+                    base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                    remain_rows, mpl0, mpr0, qp, n,
+                    qlen, w, remain_end, inf_min, dp_end0,
+                    o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
+                    plane16=plane16)
+
+            if use_pallas:
+                # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
+                # back in-jit to the XLA scan on ring/band overflow (measured
+                # rate on sim10k graphs: 0.0%, PERF.md)
+                from .pallas_fused import pallas_fused_dp
+                N_, E_ = pre_idx.shape
+                is_src_out = (mpl0 == 1) & (mpr0 == 1) & \
+                    (jnp.arange(N_) > 0)
+                base_packed = base_r | (is_src_out.astype(jnp.int32) << 8)
+                pre_cnt = jnp.sum(pre_msk.astype(jnp.int32), axis=1)
+                out_cnt_r = jnp.sum(out_msk.astype(jnp.int32), axis=1)
+                H0, E10, E20, F10, F20 = _row0_planes(
+                    W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf_min)
+                row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
+                qp_padW = jnp.pad(qp, ((0, 0), (0, W)))
+                sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1, e2, oe2,
+                                n, dp_end0] + [jnp.int32(0)] * 6)
+                (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p) = pallas_fused_dp(
+                    sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
+                    remain_rows, row0H, row0E1, row0E2, qp_padW,
+                    R=N_, W=W, P=E_, O=E_, interpret=pl_interpret)
+                # the kernel writes rows 1..: patch the source row in
+                end_p = end_p.at[0].set(dp_end0)
+                beg_p = beg_p.at[0].set(0)
+
+                def take_pl(_):
+                    zeros = jnp.zeros(N_, jnp.int32)
+                    return (Hp.at[0].set(H0), E1p.at[0].set(E10),
+                            E2p.at[0].set(E20), F1p.at[0].set(F10),
+                            F2p.at[0].set(F20), beg_p, end_p,
+                            zeros, zeros, jnp.bool_(False))
+
+                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                 overflow) = lax.cond(ok_p[0] == 1, take_pl, dp_scan_path, None)
+            else:
+                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                 overflow) = dp_scan_path(None)
 
             # global best over the sink's predecessor rows at their band ends
             sink_rows = pre_idx[n - 1]
@@ -1059,7 +1114,8 @@ def fused_eligible(abpt: Params, n_seq: int) -> bool:
 def progressive_poa_fused(seqs: List[np.ndarray],
                           weights: List[np.ndarray],
                           abpt: Params,
-                          max_chunks: int = 24):
+                          max_chunks: int = 24,
+                          use_pallas: bool = None):
     """Run the fused loop over a read set; returns a host POAGraph ready for
     consensus/output (reference abpoa_poa, src/abpoa_align.c:313-353)."""
     n_reads = len(seqs)
@@ -1094,6 +1150,9 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     # device; ERR_PROMOTE flips to int32 once the graph outgrows the budget)
     int16_limit = int16_score_limit(abpt)
     plane16 = max_score_bound(abpt, qmax, 2) <= int16_limit
+    if use_pallas is None:
+        use_pallas = abpt.device == "pallas" and abpt.gap_mode == C.CONVEX_GAP
+    pl_interpret = jax.default_backend() != "tpu"
 
     state = init_fused_state(N, E, A)
     kahn_total = 0
@@ -1111,7 +1170,9 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             gap_on_right=bool(abpt.put_gap_on_right),
             put_gap_at_end=bool(abpt.put_gap_at_end),
             plane16=plane16, max_mat=int(abpt.max_mat),
-            int16_limit=int(int16_limit))
+            int16_limit=int(int16_limit),
+            use_pallas=bool(use_pallas) and not plane16,
+            pl_interpret=pl_interpret)
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:

@@ -559,7 +559,7 @@ def align_windows_jax(g: POAGraph, abpt: Params,
     arrays = {k: jnp.asarray(np.stack([p[k] for p in padded]))
               for k in _ARRAY_KEYS}
     arrays["mat"] = jnp.broadcast_to(jnp.asarray(mat),
-                                     (len(snaps),) + mat.shape)
+                                     (len(padded),) + mat.shape)
     scalars = {k: jnp.asarray(np.array([p[k] for p in padded], dtype=np.int32))
                for k in _SCALAR_KEYS}
     inf_min = dp_inf_min(abpt)

@@ -80,7 +80,7 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     """Route the plain progressive loop through the single-dispatch all-device
     path when the device backend is selected and the config is in scope
     (align/fused_loop.py). Returns False to fall back to the per-read loop."""
-    if abpt.device not in ("jax", "tpu") or exist_n_seq:
+    if abpt.device not in ("jax", "tpu", "pallas") or exist_n_seq:
         return False
     from .align.fused_loop import fused_eligible, progressive_poa_fused
     if not fused_eligible(abpt, len(seqs)):
