@@ -1,4 +1,9 @@
-"""Prototype all-device progressive POA loop.
+"""Prototype all-device progressive POA loop (round 1).
+
+SUPERSEDED by align/fused_loop.py, which wraps the whole read set in one
+jitted while_loop with banded storage, capacity growth, int16 promotion and
+an optional Pallas kernel; this module remains as the readable stepping-stone
+design and is still covered by tests/test_device_pipeline.py.
 
 Composes the device-resident pieces end-to-end for plain (unseeded) global
 progressive POA:

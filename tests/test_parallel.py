@@ -31,8 +31,9 @@ def test_shard_dp_batch_8way():
 
     mesh, step = shard_dp_batch(8)
     import __graft_entry__ as ge
-    args = ge._example_inputs()
-    arrays, scalars = args[:10], jnp.stack([jnp.int32(a) for a in args[10:]])
+    args, _gap_mode = ge._real_read_tables()
+    # args[10] is the fused-kernel row count; _dp_scan takes the 11 scalars after
+    arrays, scalars = args[:10], jnp.stack([jnp.int32(a) for a in args[11:]])
     stacked = [jnp.broadcast_to(jnp.asarray(a)[None], (8,) + jnp.asarray(a).shape)
                for a in arrays]
     stacked.append(jnp.broadcast_to(scalars[None], (8,) + scalars.shape))
