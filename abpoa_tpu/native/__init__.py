@@ -8,14 +8,41 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "host_core.cpp")
-_LIB = os.path.join(_HERE, f"libabpoa_host_{sys.implementation.cache_tag}.so")
+
+
+def _host_tag() -> str:
+    """Discriminate the .so cache by host CPU: -march=native binaries must not
+    be reused on a machine with a different ISA (SIGILL otherwise)."""
+    import hashlib
+    import platform
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fp:
+            for line in fp:
+                if line.startswith(("flags", "Features")):
+                    tag += hashlib.sha1(line.encode()).hexdigest()[:8]
+                    break
+    except OSError:
+        pass
+    return tag
+
+
+_LIB = os.path.join(
+    _HERE, f"libabpoa_host_{sys.implementation.cache_tag}_{_host_tag()}.so")
 
 _lib = None
 
 
 def _build() -> None:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
-    subprocess.run(cmd, check=True, capture_output=True)
+    # -march=native unlocks the host's full vector width for the autovectorized
+    # DP inner loops (the library is built on demand per host, so this is safe);
+    # fall back to the portable baseline if the toolchain rejects it
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(base[:2] + ["-march=native"] + base[2:],
+                       check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        subprocess.run(base, check=True, capture_output=True)
 
 
 def load():

@@ -37,6 +37,9 @@ struct Graph {
     // persistent DP workspaces (reused across alignments, like the
     // reference's abpoa_simd_matrix_t)
     std::vector<int32_t> wsH, wsE1, wsE2, wsF1, wsF2;
+    std::vector<int32_t> ws_qprof;  // per-alignment query profile (m x qlen+1)
+    std::vector<int32_t> ws_pre, ws_pre_off;  // flattened per-row pred lists
+    std::vector<uint8_t> ws_index_map;
     std::vector<int64_t> ws_row_ptr;
     std::vector<int32_t> ws_beg, ws_end;
 
@@ -677,8 +680,11 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     const int32_t inf = std::max(std::max(KINT32_MIN + min_mis, KINT32_MIN + oe1),
                                  KINT32_MIN + oe2) + 512 * std::max(e1, e2);
 
-    // subgraph reachability mask (abpoa_align_simd.c:1259-1269)
-    std::vector<uint8_t> index_map(g.n(), 0);
+    // subgraph reachability mask (abpoa_align_simd.c:1259-1269); persistent
+    // workspace — per-alignment vector-of-vectors allocation dominated the
+    // per-row overhead at 40k+ rows
+    std::vector<uint8_t>& index_map = g.ws_index_map;
+    index_map.assign(g.n(), 0);
     index_map[beg_index] = index_map[end_index] = 1;
     for (int i = beg_index; i < end_index - 1; ++i) {
         if (!index_map[i]) continue;
@@ -686,16 +692,32 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             index_map[g.node_id_to_index[out_id]] = 1;
     }
 
-    // filtered predecessor lists per dp row
-    std::vector<std::vector<int32_t>> pre(gn);
+    // filtered predecessor lists per dp row, flattened CSR
+    std::vector<int32_t>& pre_flat = g.ws_pre;
+    std::vector<int32_t>& pre_off = g.ws_pre_off;
+    if ((int)pre_off.size() < gn + 1) pre_off.resize(gn + 1);
+    pre_flat.clear();
+    pre_off[0] = pre_off[1] = 0;
     for (int i = 1; i < gn; ++i) {
-        int nid = g.index_to_node_id[beg_index + i];
-        if (!index_map[beg_index + i]) continue;
-        for (int in_id : g.nodes[nid].in_ids) {
-            int p = g.node_id_to_index[in_id];
-            if (index_map[p]) pre[i].push_back(p - beg_index);
+        if (index_map[beg_index + i]) {
+            int nid = g.index_to_node_id[beg_index + i];
+            for (int in_id : g.nodes[nid].in_ids) {
+                int p = g.node_id_to_index[in_id];
+                if (index_map[p]) pre_flat.push_back(p - beg_index);
+            }
         }
+        pre_off[i + 1] = (int32_t)pre_flat.size();
     }
+    struct PreView {
+        const int32_t* flat; const int32_t* off;
+        struct Range { const int32_t* b; const int32_t* e;
+                       const int32_t* begin() const { return b; }
+                       const int32_t* end() const { return e; } };
+        Range operator[](int i) const {
+            return {flat + off[i], flat + off[i + 1]};
+        }
+    };
+    const PreView pre{pre_flat.data(), pre_off.data()};
 
     const int32_t remain_end = banded || params[4] > 0 ? g.max_remain[end_node_id] : 0;
     auto ad_beg = [&](int nid) {
@@ -764,6 +786,20 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     int best_i = 0, best_j = 0, best_nid = beg_node_id;
     std::vector<int32_t> Mq, E1r, E2r, Hh;
 
+    // query profile: qprof[k][j] = mat[k][query[j-1]], qprof[k][0] = 0 — one
+    // gather pass per alignment so the per-row profile add is a contiguous
+    // (vectorizable) load (the reference builds qp the same way,
+    // abpoa_align_simd.c:463-580)
+    std::vector<int32_t>& qprof = g.ws_qprof;
+    if ((int64_t)qprof.size() < (int64_t)m * (qlen + 1))
+        qprof.resize((int64_t)m * (qlen + 1));
+    for (int k = 0; k < m; ++k) {
+        int32_t* qp = qprof.data() + (int64_t)k * (qlen + 1);
+        const int32_t* mk = mat + (int64_t)k * m;
+        qp[0] = 0;
+        for (int j = 1; j <= qlen; ++j) qp[j] = mk[query[j - 1]];
+    }
+
     // ---- row loop ---------------------------------------------------------
     bool zdropped = false;
     for (int index_i = beg_index + 1; index_i < end_index && !zdropped; ++index_i) {
@@ -786,7 +822,7 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         E1r.assign(width, linear ? inf - e1 : inf);
         if (convex) E2r.assign(width, inf);
         const uint8_t base = g.nodes[nid].base;
-        const int32_t* mrow = mat + (int64_t)base * m;
+        const int32_t* qrow = qprof.data() + (int64_t)base * (qlen + 1);
 
         for (int p : pre[i]) {
             const int pb = dp.beg[p], pe = dp.end[p];
@@ -822,14 +858,21 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             }
         }
         if (local && b == 0 && Mq[0] < 0) Mq[0] = 0;  // H[-1] treated as 0
-        // add query profile; Hhat = max(M+q, E)
-        Hh.assign(width, inf);
-        for (int j = b; j <= e; ++j) {
-            int32_t q = j >= 1 ? mrow[query[j - 1]] : 0;
-            Mq[j - b] += q;
-            int32_t v = std::max(Mq[j - b], E1r[j - b]);
-            if (convex) v = std::max(v, E2r[j - b]);
-            Hh[j - b] = v;
+        // add query profile; Hhat = max(M+q, E) — contiguous, vectorizable
+        Hh.resize(width);  // fully overwritten below; no fill needed
+        {
+            const int32_t* qj = qrow + b;
+            if (convex) {
+                for (int j = 0; j < width; ++j) {
+                    Mq[j] += qj[j];
+                    Hh[j] = std::max(std::max(Mq[j], E1r[j]), E2r[j]);
+                }
+            } else {
+                for (int j = 0; j < width; ++j) {
+                    Mq[j] += qj[j];
+                    Hh[j] = std::max(Mq[j], E1r[j]);
+                }
+            }
         }
         int64_t pi = dp.row_ptr[i];
         if (linear) {
@@ -842,45 +885,74 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                 dp.H[pi + j] = local ? std::max(v, 0) : v;
             }
         } else {
-            // F chains: F[b]=Mq[b]-oe; F[j]=max(Hh[j-1]-oe, F[j-1]-e)
-            int32_t f1 = Mq[0] - oe1, f2 = convex ? Mq[0] - oe2 : inf;
-            for (int j = 0; j < width; ++j) {
-                if (j > 0) {
+            // F chains: F[b]=Mq[b]-oe; F[j]=max(Hh[j-1]-oe, F[j-1]-e).
+            // The carry is latency-bound and unavoidable (a log-doubling
+            // vectorized form was measured SLOWER at typical ~220-cell
+            // bands), so keep ONLY the carry sequential and finalize
+            // H/E elementwise in a separate autovectorized pass.
+            int32_t* F1row = dp.F1.data() + pi;
+            int32_t* E1row = dp.E1.data() + pi;
+            int32_t* Hrow = dp.H.data() + pi;
+            if (convex) {
+                int32_t* F2row = dp.F2.data() + pi;
+                int32_t* E2row = dp.E2.data() + pi;
+                int32_t f1 = Mq[0] - oe1, f2 = Mq[0] - oe2;
+                F1row[0] = f1;
+                F2row[0] = f2;
+                for (int j = 1; j < width; ++j) {
                     f1 = std::max(Hh[j - 1] - oe1, f1 - e1);
-                    if (convex) f2 = std::max(Hh[j - 1] - oe2, f2 - e2);
+                    f2 = std::max(Hh[j - 1] - oe2, f2 - e2);
+                    F1row[j] = f1;
+                    F2row[j] = f2;
                 }
-                int32_t hrow = std::max(Hh[j], f1);
-                if (convex) hrow = std::max(hrow, f2);
-                if (local) hrow = std::max(hrow, 0);
-                int32_t e1n;
-                if (gap_mode == 1) {
-                    e1n = (hrow == Hh[j])
-                        ? std::max((int32_t)(E1r[j] - e1), hrow - oe1)
-                        : (local ? 0 : inf);
-                } else {
-                    e1n = std::max((int32_t)(E1r[j] - e1), hrow - oe1);
-                    if (local && e1n < 0) e1n = 0;
-                }
-                dp.H[pi + j] = hrow;
-                dp.E1[pi + j] = e1n;
-                dp.F1[pi + j] = f1;
-                if (convex) {
+                for (int j = 0; j < width; ++j) {
+                    int32_t hrow = std::max(std::max(Hh[j], F1row[j]), F2row[j]);
+                    if (local) hrow = std::max(hrow, 0);
+                    int32_t e1n = std::max((int32_t)(E1r[j] - e1), hrow - oe1);
                     int32_t e2n = std::max((int32_t)(E2r[j] - e2), hrow - oe2);
-                    if (local && e2n < 0) e2n = 0;
-                    dp.E2[pi + j] = e2n;
-                    dp.F2[pi + j] = f2;
+                    if (local) {
+                        e1n = std::max(e1n, 0);
+                        e2n = std::max(e2n, 0);
+                    }
+                    Hrow[j] = hrow;
+                    E1row[j] = e1n;
+                    E2row[j] = e2n;
+                }
+            } else {
+                int32_t f1 = Mq[0] - oe1;
+                F1row[0] = f1;
+                for (int j = 1; j < width; ++j) {
+                    f1 = std::max(Hh[j - 1] - oe1, f1 - e1);
+                    F1row[j] = f1;
+                }
+                const int32_t dead = local ? 0 : inf;
+                for (int j = 0; j < width; ++j) {
+                    int32_t hrow = std::max(Hh[j], F1row[j]);
+                    if (local) hrow = std::max(hrow, 0);
+                    // affine E kill when F strictly dominates H
+                    // (abpoa_align_simd.c:926-930)
+                    int32_t e1n = (hrow == Hh[j])
+                        ? std::max((int32_t)(E1r[j] - e1), hrow - oe1) : dead;
+                    Hrow[j] = hrow;
+                    E1row[j] = e1n;
                 }
             }
         }
 
         // ---- row max: local/extend scoring + adaptive band ----------------
         if (local || extend || banded) {
+            // vectorizable max reduction, then first/last-equal scans
+            const int32_t* Hp = dp.H.data() + pi;
             int32_t mx = inf;
+            for (int j = 0; j < width; ++j) mx = std::max(mx, Hp[j]);
             int left = -1, right = -1;
-            for (int j = 0; j < width; ++j) {
-                int32_t v = dp.H[pi + j];
-                if (v > mx) { mx = v; left = right = b + j; }
-                else if (v == mx && left >= 0) right = b + j;
+            if (mx > inf) {
+                int j = 0;
+                while (Hp[j] != mx) ++j;
+                left = b + j;
+                j = width - 1;
+                while (Hp[j] != mx) --j;
+                right = b + j;
             }
             if (local) {
                 if (mx > best_score) { best_score = mx; best_i = i; best_j = left; }
