@@ -106,6 +106,9 @@ class POAGraph:
         if add_read_weight:
             fr.read_weight[read_id] = w
 
+    def node_base(self, node_id: int) -> int:
+        return self.nodes[node_id].base
+
     def get_aligned_id(self, node_id: int, base: int) -> int:
         for aln_id in self.nodes[node_id].aligned_ids:
             if self.nodes[aln_id].base == base:

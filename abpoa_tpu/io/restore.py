@@ -119,7 +119,7 @@ def _parse_msa_fa(ab, abpt: Params, records) -> None:
             if cur_id == 0:
                 cur_id = g.add_node(base)
                 rank2node_id[rank] = cur_id
-            elif g.nodes[cur_id].base != base:
+            elif g.node_base(cur_id) != base:
                 aln_id = g.get_aligned_id(cur_id, base)
                 if aln_id == -1:
                     aln_id = g.add_node(base)

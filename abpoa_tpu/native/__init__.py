@@ -71,6 +71,16 @@ def load():
     lib.apg_is_sorted.argtypes = [c.c_void_p]
     lib.apg_is_sorted.restype = c.c_int
     lib.apg_topological_sort.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_add_node.argtypes = [c.c_void_p, c.c_int]
+    lib.apg_add_node.restype = c.c_int
+    lib.apg_add_edge.argtypes = [c.c_void_p, c.c_int, c.c_int, c.c_int,
+                                 c.c_int, c.c_int, c.c_int, c.c_int, c.c_int]
+    lib.apg_add_aligned_node.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_invalidate_sort.argtypes = [c.c_void_p]
+    lib.apg_node_base.argtypes = [c.c_void_p, c.c_int]
+    lib.apg_node_base.restype = c.c_int
+    lib.apg_get_aligned_id.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_get_aligned_id.restype = c.c_int
     lib.apg_add_alignment.argtypes = [
         c.c_void_p, c.c_int, c.c_int, u8p, i64p, c.c_int, u64p, c.c_int,
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, i64p]

@@ -49,6 +49,14 @@ class NativePOAGraph:
     def is_topological_sorted(self) -> bool:
         return bool(self._lib.apg_is_sorted(self._h))
 
+    @is_topological_sorted.setter
+    def is_topological_sorted(self, value: bool) -> None:
+        # restore/reset paths clear this to force a re-sort; the C side
+        # already cleared it on every mutation, so only honor False
+        if value:
+            raise ValueError("cannot force-mark a native graph as sorted")
+        self._lib.apg_invalidate_sort(self._h)
+
     def reset(self) -> None:
         self._lib.apg_reset(self._h)
         self._version += 1
@@ -108,6 +116,29 @@ class NativePOAGraph:
         if qpos_to_node_id is not None:
             qpos_to_node_id[:seq_l] = qpos[:seq_l]
         self._version += 1
+
+    def add_node(self, base: int) -> int:
+        """Graph-building primitive used by incremental-MSA restore
+        (io/restore.py; reference src/abpoa_seq.c:608-673)."""
+        return int(self._lib.apg_add_node(self._h, int(base)))
+
+    def add_edge(self, from_id: int, to_id: int, check_edge: bool, w: int,
+                 add_read_id: bool, add_read_weight: bool, read_id: int,
+                 tot_read_n: int) -> None:
+        self._lib.apg_add_edge(self._h, int(from_id), int(to_id),
+                               1 if check_edge else 0, int(w),
+                               1 if add_read_id else 0,
+                               1 if add_read_weight else 0, int(read_id),
+                               int(tot_read_n))
+
+    def add_aligned_node(self, node_id: int, aligned_id: int) -> None:
+        self._lib.apg_add_aligned_node(self._h, int(node_id), int(aligned_id))
+
+    def node_base(self, node_id: int) -> int:
+        return int(self._lib.apg_node_base(self._h, int(node_id)))
+
+    def get_aligned_id(self, node_id: int, base: int) -> int:
+        return int(self._lib.apg_get_aligned_id(self._h, int(node_id), int(base)))
 
     def add_alignment(self, abpt: Params, seq, weight, qpos_to_node_id, cigar,
                       read_id: int, tot_read_n: int, inc_both_ends: bool) -> None:

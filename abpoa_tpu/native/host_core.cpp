@@ -220,10 +220,41 @@ void* apg_create() { return new Graph(); }
 void apg_destroy(void* h) { delete (Graph*)h; }
 void apg_reset(void* h) { ((Graph*)h)->reset(); }
 int apg_node_n(void* h) { return ((Graph*)h)->n(); }
+void apg_invalidate_sort(void* h) { ((Graph*)h)->sorted = false; }
 int apg_is_sorted(void* h) { return ((Graph*)h)->sorted ? 1 : 0; }
 
 void apg_topological_sort(void* h, int banded, int zdrop) {
     topological_sort(*(Graph*)h, banded != 0, zdrop != 0);
+}
+
+// graph-building primitives for incremental-MSA restore (reference
+// abpoa_restore_graph path, src/abpoa_seq.c:608-673)
+int apg_add_node(void* h, int base) {
+    Graph& g = *(Graph*)h;
+    g.sorted = false;
+    return add_node(g, (uint8_t)base);
+}
+
+void apg_add_edge(void* h, int from_id, int to_id, int check_edge, int w,
+                  int add_read_id, int add_read_weight, int read_id,
+                  int tot_read_n) {
+    Graph& g = *(Graph*)h;
+    g.sorted = false;
+    int read_ids_n = tot_read_n > 0 ? 1 + ((tot_read_n - 1) >> 6) : 1;
+    add_edge(g, from_id, to_id, check_edge != 0, w, add_read_id != 0,
+             add_read_weight != 0, read_id, read_ids_n);
+}
+
+void apg_add_aligned_node(void* h, int node_id, int aligned_id) {
+    add_aligned_node(*(Graph*)h, node_id, aligned_id);
+}
+
+int apg_node_base(void* h, int node_id) {
+    return ((Graph*)h)->nodes[node_id].base;
+}
+
+int apg_get_aligned_id(void* h, int node_id, int base) {
+    return get_aligned_id(*(Graph*)h, node_id, (uint8_t)base);
 }
 
 // Fuse one alignment (or seed an empty graph). Returns 0 on success.

@@ -97,12 +97,11 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
 
 def _want_native(abpt: Params) -> bool:
     # native host core pairs with the device kernel; the numpy oracle reads
-    # Python Node objects directly, and the oracle-only corner flags need it
+    # Python Node objects directly, and the oracle-only corner flag needs it
     if abpt.device == "native":
-        return not abpt.inc_path_score and not abpt.incr_fn
+        return not abpt.inc_path_score
     return (abpt.device in ("jax", "tpu", "pallas")
-            and not abpt.inc_path_score and abpt.zdrop <= 0
-            and not abpt.incr_fn)
+            and not abpt.inc_path_score and abpt.zdrop <= 0)
 
 
 def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:

@@ -150,9 +150,7 @@ class msa_aligner:
         if incr_fn:
             abpt.incr_fn = incr_fn if isinstance(incr_fn, str) else incr_fn.decode()
             from .io.restore import restore_graph
-            if getattr(self.ab.graph, "is_native", False):
-                self.ab.graph = POAGraph()
-            restore_graph(self.ab, abpt)
+            restore_graph(self.ab, abpt)  # works on both graph engines
             exist_n = self.ab.n_seq
         else:
             abpt.incr_fn = None

