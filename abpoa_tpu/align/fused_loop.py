@@ -90,9 +90,13 @@ class FusedState(NamedTuple):
     read_idx: jnp.ndarray  # () int32: number of reads fused so far
     err: jnp.ndarray      # () int32 error code
     kahn_runs: jnp.ndarray  # () int32: spliced-order violations repaired
+    paths: jnp.ndarray    # (n_reads, Pcap) each read's fusion path node ids
+    path_lens: jnp.ndarray  # (n_reads,)
+    collisions: jnp.ndarray  # () int32: sequential-fusion fallbacks taken
 
 
-def init_fused_state(N: int, E: int, A: int) -> FusedState:
+def init_fused_state(N: int, E: int, A: int, n_reads: int = 1,
+                     Pcap: int = 8) -> FusedState:
     return FusedState(
         g=init_device_graph(N, E, A),
         order=jnp.zeros(N, jnp.int32),
@@ -100,7 +104,10 @@ def init_fused_state(N: int, E: int, A: int) -> FusedState:
         remain=jnp.zeros(N, jnp.int32),
         read_idx=jnp.int32(0),
         err=jnp.int32(ERR_OK),
-        kahn_runs=jnp.int32(0))
+        kahn_runs=jnp.int32(0),
+        paths=jnp.zeros((n_reads, Pcap), jnp.int32),
+        path_lens=jnp.zeros(n_reads, jnp.int32),
+        collisions=jnp.int32(0))
 
 
 # --------------------------------------------------------------------------- #
@@ -851,14 +858,23 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
     # (src qlen+1 ... last seq node 0, sink -1), no override needed
     remain_by_node = jnp.where(jnp.arange(N) < node_n,
                                node_n - 2 - n2i, 0).astype(jnp.int32)
+    # seed read path = the chain nodes 2..qlen+1 (for read-id replay);
+    # harmless no-op when the dummy (1, 8) buffer is in use (out-of-bounds
+    # scatters drop, and replay only runs when the real buffer was sized)
+    Pcap = state.paths.shape[1]
+    pk = jnp.arange(Pcap, dtype=jnp.int32)
+    seed_path = jnp.where(pk < qlen, pk + 2, 0)
+    paths = state.paths.at[state.read_idx].set(seed_path)
+    path_lens = state.path_lens.at[state.read_idx].set(qlen)
     return FusedState(g=g2, order=order, n2i=n2i, remain=remain_by_node,
                       read_idx=state.read_idx + 1, err=state.err,
-                      kahn_runs=state.kahn_runs)
+                      kahn_runs=state.kahn_runs, paths=paths,
+                      path_lens=path_lens, collisions=state.collisions)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
-    "max_mat", "int16_limit", "use_pallas", "pl_interpret"))
+    "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
@@ -866,7 +882,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     gap_on_right: bool, put_gap_at_end: bool,
                     plane16: bool = False, max_mat: int = 0,
                     int16_limit: int = 0, use_pallas: bool = False,
-                    pl_interpret: bool = False) -> FusedState:
+                    pl_interpret: bool = False,
+                    record_paths: bool = False) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -1050,6 +1067,15 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     lambda x, y: jnp.where(keep, x, y), a, b)
 
             g_out = pick(st.g, g3)
+            if record_paths:
+                Pcap = st.paths.shape[1]
+                path_slice = lax.dynamic_slice(path_nodes, (0,), (Pcap,))
+                paths = st.paths.at[st.read_idx].set(
+                    jnp.where(keep, st.paths[st.read_idx], path_slice))
+                path_lens = st.path_lens.at[st.read_idx].set(
+                    jnp.where(keep, st.path_lens[st.read_idx], path_len))
+            else:
+                paths, path_lens = st.paths, st.path_lens
             return FusedState(
                 g=g_out,
                 order=jnp.where(keep, order, order3),
@@ -1057,7 +1083,9 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                 remain=jnp.where(keep, remain, remain3),
                 read_idx=jnp.where(keep, st.read_idx, st.read_idx + 1),
                 err=err,
-                kahn_runs=st.kahn_runs + jnp.where(~keep & need_kahn, 1, 0))
+                kahn_runs=st.kahn_runs + jnp.where(~keep & need_kahn, 1, 0),
+                paths=paths, path_lens=path_lens,
+                collisions=st.collisions + jnp.where(~keep & collision, 1, 0))
 
         return lax.cond(st.g.node_n == 2, seed, align_and_fuse, st)
 
@@ -1094,7 +1122,9 @@ def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
     return FusedState(
         g=g2, order=grow1(state.order), n2i=grow1(state.n2i),
         remain=grow1(state.remain), read_idx=state.read_idx,
-        err=jnp.int32(ERR_OK), kahn_runs=state.kahn_runs)
+        err=jnp.int32(ERR_OK), kahn_runs=state.kahn_runs,
+        paths=state.paths, path_lens=state.path_lens,
+        collisions=state.collisions)
 
 
 def fused_eligible(abpt: Params, n_seq: int) -> bool:
@@ -1104,7 +1134,7 @@ def fused_eligible(abpt: Params, n_seq: int) -> bool:
             and abpt.wb >= 0
             and not abpt.inc_path_score
             and abpt.zdrop <= 0
-            and not abpt.use_read_ids
+            and not (abpt.use_qv and abpt.max_n_cons > 1)
             and not abpt.amb_strand
             and not abpt.incr_fn
             and abpt.ret_cigar
@@ -1154,7 +1184,10 @@ def progressive_poa_fused(seqs: List[np.ndarray],
         use_pallas = abpt.device == "pallas" and abpt.gap_mode == C.CONVEX_GAP
     pl_interpret = jax.default_backend() != "tpu"
 
-    state = init_fused_state(N, E, A)
+    record_paths = bool(abpt.use_read_ids)
+    state = init_fused_state(N, E, A,
+                             n_reads=n_reads if record_paths else 1,
+                             Pcap=Qp + 2 if record_paths else 8)
     kahn_total = 0
     for _ in range(max_chunks):
         max_ops = N + Qp + 8
@@ -1172,7 +1205,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             plane16=plane16, max_mat=int(abpt.max_mat),
             int16_limit=int(int16_limit),
             use_pallas=bool(use_pallas) and not plane16,
-            pl_interpret=pl_interpret)
+            pl_interpret=pl_interpret, record_paths=record_paths)
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:
@@ -1207,7 +1240,53 @@ def progressive_poa_fused(seqs: List[np.ndarray],
         raise RuntimeError("fused loop: capacity growth did not converge")
     kahn_total = int(state.kahn_runs)
 
-    return _download_graph(state, abpt), kahn_total
+    if abpt.use_read_ids and int(state.collisions) > 0:
+        # a sequential-fusion fallback may have taken a different path than
+        # the recorded one (same-group interactions); the replayed bitsets
+        # would be wrong for those reads — let the caller use the host loop
+        raise RuntimeError(
+            f"fused loop: {int(state.collisions)} sequential-fusion "
+            "fallbacks; read-id replay unavailable")
+
+    pg = _download_graph(state, abpt)
+    if abpt.use_read_ids:
+        _replay_read_ids(pg, state, n_reads)
+    return pg, kahn_total
+
+
+def _replay_read_ids(pg, state: FusedState, n_reads: int) -> None:
+    """Reconstruct per-edge read-id bitsets from the recorded fusion paths
+    (reference: abpoa_set_read_id during fusion, abpoa_graph.c:465-469).
+    Each read's path visits each node once, so its edge set is exactly the
+    consecutive pairs SRC -> p0 -> ... -> p(L-1) -> SINK. Vectorized: the
+    (edge, read) pairs accumulate into a uint64 word matrix with
+    np.bitwise_or.at, then one Python pass converts per-edge words to the
+    graph's arbitrary-precision int bitsets."""
+    paths = np.asarray(state.paths)
+    lens = np.asarray(state.path_lens)
+    n_nodes = pg.node_n
+    frs, tos, rids = [], [], []
+    for r in range(n_reads):
+        L = int(lens[r])
+        p = paths[r, :L].astype(np.int64)
+        fr = np.concatenate(([C.SRC_NODE_ID], p))
+        to = np.concatenate((p, [C.SINK_NODE_ID]))
+        frs.append(fr)
+        tos.append(to)
+        rids.append(np.full(L + 1, r, np.int64))
+    fr = np.concatenate(frs)
+    to = np.concatenate(tos)
+    rid = np.concatenate(rids)
+    keys = fr * n_nodes + to
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    n_words = (n_reads + 63) >> 6
+    words = np.zeros((len(uniq), n_words), np.uint64)
+    np.bitwise_or.at(words, (inverse, rid >> 6),
+                     np.uint64(1) << (rid & 63).astype(np.uint64))
+    for e, key in enumerate(uniq):
+        nd = pg.nodes[int(key) // n_nodes]
+        slot = nd.out_ids.index(int(key) % n_nodes)
+        nd.read_ids[slot] = int.from_bytes(words[e].tobytes(), "little")
 
 
 def _download_graph(state: FusedState, abpt: Params):

@@ -107,6 +107,30 @@ def test_fused_scale_long_reads(tmp_path):
     assert kahn > 0  # the repair path must actually have been exercised
 
 
+@pytest.mark.parametrize("flags", [["-r1"], ["-r3"], ["-d2"]])
+def test_fused_read_id_outputs(flags):
+    """MSA / GFA / diploid outputs need per-edge read-id bitsets; the fused
+    loop records each read's fusion path on device and replays the bitsets
+    on the host (reference abpoa_set_read_id, abpoa_graph.c:465-469)."""
+    import subprocess
+    fname = "heter.fa" if "-d2" in flags else "seq.fa"
+    path = os.path.join(DATA_DIR, fname)
+
+    def cli(device):
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import sys, runpy\n"
+            f"sys.argv = ['abpoa', '--device', {device!r}] + {flags!r} + [{path!r}]\n"
+            "runpy.run_module('abpoa_tpu.cli', run_name='__main__')\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "falling back" not in proc.stderr
+        return proc.stdout
+
+    assert cli("jax") == cli("numpy")
+
+
 def test_fused_pipeline_wiring():
     """device=jax routes the plain progressive loop through the fused path."""
     path = os.path.join(DATA_DIR, "seq.fa")
