@@ -1,0 +1,18 @@
+"""abpoa_tpu: TPU-native adaptive banded Partial Order Alignment.
+
+A ground-up reimplementation of the capabilities of abPOA (yangao07/abPOA)
+with the banded sequence-to-graph DP lowered to JAX/Pallas kernels on TPU,
+and the mutable POA graph, backtrack, consensus, and I/O on host.
+"""
+__version__ = "0.1.0"
+
+from . import constants
+from .params import Params
+from .graph import POAGraph
+from .pipeline import Abpoa, msa, msa_from_file
+from .align import align_sequence_to_graph, align_sequence_to_subgraph, AlignResult
+
+__all__ = [
+    "constants", "Params", "POAGraph", "Abpoa", "msa", "msa_from_file",
+    "align_sequence_to_graph", "align_sequence_to_subgraph", "AlignResult",
+]

@@ -1,0 +1,4 @@
+from .result import AlignResult
+from .dispatch import align_sequence_to_graph, align_sequence_to_subgraph
+
+__all__ = ["AlignResult", "align_sequence_to_graph", "align_sequence_to_subgraph"]

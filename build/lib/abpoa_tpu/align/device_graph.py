@@ -1,0 +1,371 @@
+"""Device-resident POA graph: dense arrays + jitted fusion and topo sort.
+
+Foundation for the all-device progressive loop (PERF.md round-2 plan): keep
+the whole POA graph in fixed-capacity device arrays and run
+align -> backtrack -> FUSE -> TOPO-SORT for every read inside one jitted loop,
+so the high-latency host link is touched once per read set instead of once
+per read.
+
+The semantics mirror the host engines exactly (graph.py / native/host_core.cpp,
+reference /root/reference/src/abpoa_graph.c:480-774):
+- fusion walks the op stream emitted by the device backtrack
+  (jax_backtrack.device_backtrack): match reuses/aligns nodes, insertion adds
+  node chains, deletion skips;
+- edges live in fixed-width slots per node (append-or-reweight);
+- aligned-mismatch groups keep the reference's mutual-registration rule;
+- Kahn BFS topo sort with aligned-group atomicity, weight-descending exchange
+  sort of edge slots, and the reverse-BFS max_remain metric.
+
+Capacities (node count N, edge slots E, aligned slots A) are static; overflow
+sets an `ok` flag so callers can fall back to the host engine.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import constants as C
+
+
+class DeviceGraph(NamedTuple):
+    """Dense POA graph state (all int32 unless noted)."""
+    base: jnp.ndarray       # (N,)
+    in_ids: jnp.ndarray     # (N, E)
+    in_w: jnp.ndarray       # (N, E)
+    in_cnt: jnp.ndarray     # (N,)
+    out_ids: jnp.ndarray    # (N, E)
+    out_w: jnp.ndarray      # (N, E)
+    out_cnt: jnp.ndarray    # (N,)
+    aligned: jnp.ndarray    # (N, A)
+    aligned_cnt: jnp.ndarray  # (N,)
+    n_read: jnp.ndarray     # (N,)
+    n_span: jnp.ndarray     # (N,)
+    node_n: jnp.ndarray     # () scalar
+    ok: jnp.ndarray         # () bool
+
+
+def init_device_graph(N: int, E: int, A: int) -> DeviceGraph:
+    z = jnp.zeros
+    return DeviceGraph(
+        base=z(N, jnp.int32),
+        in_ids=z((N, E), jnp.int32), in_w=z((N, E), jnp.int32), in_cnt=z(N, jnp.int32),
+        out_ids=z((N, E), jnp.int32), out_w=z((N, E), jnp.int32), out_cnt=z(N, jnp.int32),
+        aligned=z((N, A), jnp.int32), aligned_cnt=z(N, jnp.int32),
+        n_read=z(N, jnp.int32), n_span=z(N, jnp.int32),
+        node_n=jnp.int32(2), ok=jnp.bool_(True))
+
+
+def _add_edge(g: DeviceGraph, fr, to, check, w) -> DeviceGraph:
+    """Append-or-reweight an edge fr->to (abpoa_graph.c:480-556)."""
+    E = g.in_ids.shape[1]
+    slots = jnp.arange(E, dtype=jnp.int32)
+
+    # out slot of `fr` pointing at `to` (valid slots only)
+    om = (slots < g.out_cnt[fr]) & (g.out_ids[fr] == to)
+    o_exists = check & jnp.any(om)
+    o_slot = jnp.where(o_exists, jnp.argmax(om), g.out_cnt[fr]).astype(jnp.int32)
+    im = (slots < g.in_cnt[to]) & (g.in_ids[to] == fr)
+    i_exists = check & jnp.any(im)
+    i_slot = jnp.where(i_exists, jnp.argmax(im), g.in_cnt[to]).astype(jnp.int32)
+
+    ok = g.ok & (o_slot < E) & (i_slot < E)
+    out_ids = g.out_ids.at[fr, o_slot].set(to)
+    out_w = g.out_w.at[fr, o_slot].set(jnp.where(o_exists, g.out_w[fr, o_slot] + w, w))
+    out_cnt = g.out_cnt.at[fr].set(jnp.where(o_exists, g.out_cnt[fr], g.out_cnt[fr] + 1))
+    in_ids = g.in_ids.at[to, i_slot].set(fr)
+    in_w = g.in_w.at[to, i_slot].set(jnp.where(i_exists, g.in_w[to, i_slot] + w, w))
+    in_cnt = g.in_cnt.at[to].set(jnp.where(i_exists, g.in_cnt[to], g.in_cnt[to] + 1))
+    n_read = g.n_read.at[fr].add(1)
+    return g._replace(out_ids=out_ids, out_w=out_w, out_cnt=out_cnt,
+                      in_ids=in_ids, in_w=in_w, in_cnt=in_cnt,
+                      n_read=n_read, ok=ok)
+
+
+def _get_aligned_id(g: DeviceGraph, node_id, b):
+    A = g.aligned.shape[1]
+    slots = jnp.arange(A, dtype=jnp.int32)
+    ids = g.aligned[node_id]
+    m = (slots < g.aligned_cnt[node_id]) & (g.base[ids] == b)
+    return jnp.where(jnp.any(m), ids[jnp.argmax(m)], -1).astype(jnp.int32)
+
+
+def _add_aligned(g: DeviceGraph, node_id, new_id) -> DeviceGraph:
+    """Mutual registration across the whole mismatch group (abpoa_graph.c:455-463)."""
+    A = g.aligned.shape[1]
+
+    def body(k, st):
+        aligned, cnt, ok = st
+        ex = aligned[node_id, k]
+        # ex <-> new_id
+        aligned = aligned.at[ex, cnt[ex]].set(new_id)
+        aligned = aligned.at[new_id, cnt[new_id]].set(ex)
+        ok = ok & (cnt[ex] < A) & (cnt[new_id] < A)
+        cnt = cnt.at[ex].add(1).at[new_id].add(1)
+        return aligned, cnt, ok
+
+    n0 = g.aligned_cnt[node_id]
+    aligned, cnt, ok = lax.fori_loop(0, n0, body, (g.aligned, g.aligned_cnt, g.ok))
+    aligned = aligned.at[node_id, cnt[node_id]].set(new_id)
+    aligned = aligned.at[new_id, cnt[new_id]].set(node_id)
+    ok = ok & (cnt[node_id] < A) & (cnt[new_id] < A)
+    cnt = cnt.at[node_id].add(1).at[new_id].add(1)
+    return g._replace(aligned=aligned, aligned_cnt=cnt, ok=ok)
+
+
+@functools.partial(jax.jit, static_argnames=("max_ops",))
+def fuse_alignment(g: DeviceGraph, ops, n_ops, query, qlen, weight,
+                   beg_node_id, end_node_id, max_ops: int) -> DeviceGraph:
+    """Fuse one backtrack op stream into the graph (abpoa_graph.c:689-774).
+
+    ops: (max_ops, 2) int32 rows (op_code, dp_i placeholder) in FORWARD order:
+    op_code 0=match-consuming (node_id in column 1), 2=insert (count in col 1),
+    1=delete (node_id, no query consumed). Build with `ops_from_cigar`.
+    """
+    N, E = g.in_ids.shape
+
+    def seed_graph(g):
+        # empty graph: chain of qlen nodes (abpoa_graph.c:573-593)
+        def body(i, st):
+            g, last = st
+            nid = g.node_n
+            g = g._replace(base=g.base.at[nid].set(query[i]),
+                           node_n=g.node_n + 1,
+                           ok=g.ok & (nid < N))
+            g = _add_edge(g, last, nid, False, weight[i])
+            return g, nid
+        g, last = lax.fori_loop(0, qlen, body, (g, jnp.int32(C.SRC_NODE_ID)))
+        return _add_edge(g, last, jnp.int32(C.SINK_NODE_ID), False,
+                         weight[jnp.maximum(qlen - 1, 0)])
+
+    def fuse(g):
+        def body(t, st):
+            g, last, last_new, qpos = st
+            op = ops[t, 0]
+            arg = ops[t, 1]
+            is_real = t < n_ops
+
+            def do_match(st):
+                g, last, last_new, qpos = st
+                node_id = arg
+                b = query[qpos]
+                w = weight[qpos]
+                same = g.base[node_id] == b
+
+                def on_same(g):
+                    return _add_edge(g, last, node_id, 1 - last_new, w), node_id, jnp.int32(0)
+
+                def on_diff(g):
+                    aln = _get_aligned_id(g, node_id, b)
+
+                    def use_aln(g):
+                        return _add_edge(g, last, aln, 1 - last_new, w), aln, jnp.int32(0)
+
+                    def new_node(g):
+                        nid = g.node_n
+                        g = g._replace(base=g.base.at[nid].set(b),
+                                       node_n=g.node_n + 1, ok=g.ok & (nid < N))
+                        g = _add_edge(g, last, nid, False, w)
+                        g = g._replace(n_span=g.n_span.at[nid].set(g.n_span[last]))
+                        g = _add_aligned(g, node_id, nid)
+                        return g, nid, jnp.int32(1)
+                    return lax.cond(aln >= 0, use_aln, new_node, g)
+                g, new_last, nn = lax.cond(same, on_same, on_diff, g)
+                return g, new_last, nn, qpos + 1
+
+            def do_ins(st):
+                g, last, last_new, qpos = st
+                b = query[qpos]
+                w = weight[qpos]
+                nid = g.node_n
+                g = g._replace(base=g.base.at[nid].set(b),
+                               node_n=g.node_n + 1, ok=g.ok & (nid < N))
+                g = _add_edge(g, last, nid, False, w)
+                g = g._replace(n_span=g.n_span.at[nid].set(g.n_span[last]))
+                return g, nid, jnp.int32(1), qpos + 1
+
+            def do_noop(st):
+                return st
+
+            st2 = lax.cond(
+                is_real,
+                lambda s: lax.switch(jnp.clip(op, 0, 2),
+                                     [do_match, do_noop, do_ins], s),
+                do_noop, (g, last, last_new, qpos))
+            return st2
+
+        g, last, last_new, _ = lax.fori_loop(
+            0, max_ops, body,
+            (g, jnp.int32(beg_node_id), jnp.int32(0), jnp.int32(0)))
+        return _add_edge(g, last, jnp.int32(end_node_id), 1 - last_new,
+                         weight[jnp.maximum(qlen - 1, 0)])
+
+    return lax.cond(g.node_n == 2, seed_graph, fuse, g)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def topo_sort(g: DeviceGraph):
+    """Kahn BFS with aligned-group atomicity + weight-desc edge sort +
+    reverse-BFS max_remain (abpoa_graph.c:192-357).
+
+    Returns (g_sorted, index_to_node_id, node_id_to_index, max_remain, ok).
+    """
+    N, E = g.in_ids.shape
+    A = g.aligned.shape[1]
+
+    # ---- Kahn BFS with aligned-group atomicity ----------------------------
+    # NOTE: the reference BFS-orders nodes BEFORE re-sorting edges by weight
+    # (abpoa_graph.c:344-345), i.e. the BFS sees the previous call's edge
+    # order; the weight sort below applies to the DP / remain pass.
+    n = g.node_n
+    in_degree = g.in_cnt
+    queue = jnp.zeros(N, jnp.int32)
+    i2n = jnp.zeros(N, jnp.int32)
+    n2i = jnp.zeros(N, jnp.int32)
+    queue = queue.at[0].set(C.SRC_NODE_ID)
+
+    def cond(st):
+        head, tail, idx, *_ = st
+        return (head < tail) & (idx < n)
+
+    def body(st):
+        head, tail, idx, queue, i2n, n2i, in_degree = st
+        cur = queue[head]
+        i2n = i2n.at[idx].set(cur)
+        n2i = n2i.at[cur].set(idx)
+
+        def push_outs(st):
+            tail, queue, in_degree = st
+
+            def out_body(k, st):
+                tail, queue, in_degree = st
+                out_id = g.out_ids[cur, k]
+                in_degree = in_degree.at[out_id].add(-1)
+                ready = in_degree[out_id] == 0
+                grp_ok = jnp.all(
+                    jnp.where(jnp.arange(A) < g.aligned_cnt[out_id],
+                              in_degree[g.aligned[out_id]] == 0, True))
+
+                def push(st):
+                    tail, queue = st
+                    queue = queue.at[tail].set(out_id)
+                    tail = tail + 1
+
+                    def push_al(a, st):
+                        tail, queue = st
+                        queue = queue.at[tail].set(g.aligned[out_id, a])
+                        return tail + 1, queue
+                    tail, queue = lax.fori_loop(0, g.aligned_cnt[out_id],
+                                                push_al, (tail, queue))
+                    return tail, queue
+                tail, queue = lax.cond(ready & grp_ok, push,
+                                       lambda s: s, (tail, queue))
+                return tail, queue, in_degree
+            return lax.fori_loop(0, g.out_cnt[cur], out_body, st)
+
+        tail, queue, in_degree = lax.cond(
+            cur != C.SINK_NODE_ID, push_outs, lambda s: s,
+            (tail, queue, in_degree))
+        return head + 1, tail, idx + 1, queue, i2n, n2i, in_degree
+
+    head, tail, idx, queue, i2n, n2i, in_degree = lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                     queue, i2n, n2i, in_degree))
+    ok = g.ok & (idx == n)
+
+    # ---- weight-descending exchange sort of edge slots (exact tie behavior)
+    def sort_node(ids, w, cnt):
+        def outer(j, st):
+            ids, w = st
+
+            def inner(k, st):
+                ids, w = st
+                swap = (k < cnt) & (j < k) & (w[j] < w[k])
+                wj, wk = w[j], w[k]
+                ij, ik = ids[j], ids[k]
+                w = w.at[j].set(jnp.where(swap, wk, wj)).at[k].set(jnp.where(swap, wj, wk))
+                ids = ids.at[j].set(jnp.where(swap, ik, ij)).at[k].set(jnp.where(swap, ij, ik))
+                return ids, w
+            return lax.fori_loop(j + 1, E, inner, st)
+        return lax.fori_loop(0, E, outer, (ids, w))
+
+    in_ids, in_w = jax.vmap(sort_node)(g.in_ids, g.in_w, g.in_cnt)
+    out_ids, out_w = jax.vmap(sort_node)(g.out_ids, g.out_w, g.out_cnt)
+    g = g._replace(in_ids=in_ids, in_w=in_w, out_ids=out_ids, out_w=out_w)
+
+    # ---- reverse BFS max_remain ------------------------------------------
+    remain = jnp.zeros(N, jnp.int32).at[C.SINK_NODE_ID].set(-1)
+    out_degree = g.out_cnt
+    rqueue = jnp.zeros(N, jnp.int32).at[0].set(C.SINK_NODE_ID)
+
+    def rcond(st):
+        head, tail, *_ = st
+        return head < tail
+
+    def rbody(st):
+        head, tail, rqueue, remain, out_degree = st
+        cur = rqueue[head]
+
+        def set_remain(remain):
+            # argmax-weight out edge: slot 0 after the weight-desc sort is NOT
+            # sufficient (the reference scans original order with strict >),
+            # but after sorting, slot 0 holds a maximal weight; the reference
+            # computes remain AFTER the same sort, scanning slots in order
+            # with strict >, which picks slot 0 of equal-max weights too.
+            best = g.out_ids[cur, 0]
+            return remain.at[cur].set(remain[best] + 1)
+        remain = lax.cond(cur != C.SINK_NODE_ID, set_remain,
+                          lambda r: r, remain)
+
+        def push_ins(st):
+            tail, rqueue, out_degree = st
+
+            def in_body(k, st):
+                tail, rqueue, out_degree = st
+                in_id = g.in_ids[cur, k]
+                out_degree = out_degree.at[in_id].add(-1)
+
+                def push(st):
+                    tail, rqueue = st
+                    return tail + 1, rqueue.at[tail].set(in_id)
+                tail, rqueue = lax.cond(out_degree[in_id] == 0, push,
+                                        lambda s: s, (tail, rqueue))
+                return tail, rqueue, out_degree
+            return lax.fori_loop(0, g.in_cnt[cur], in_body, st)
+
+        tail, rqueue, out_degree = lax.cond(
+            cur != C.SRC_NODE_ID, push_ins, lambda s: s,
+            (tail, rqueue, out_degree))
+        return head + 1, tail, rqueue, remain, out_degree
+
+    _, _, _, remain, _ = lax.while_loop(
+        rcond, rbody, (jnp.int32(0), jnp.int32(1), rqueue, remain, out_degree))
+
+    return g._replace(ok=ok), i2n, n2i, remain, ok
+
+
+def ops_from_cigar(cigar, max_ops: int):
+    """Host helper: packed 64-bit cigar -> forward (op, arg) stream rows for
+    fuse_alignment. Returns (ops array, n_ops)."""
+    import numpy as np
+    rows = []
+    for p in cigar:
+        op = p & 0xF
+        if op == C.CMATCH:
+            rows.append((0, (p >> 34) & 0x3FFFFFFF))
+        elif op in (C.CINS, C.CSOFT_CLIP, C.CHARD_CLIP):
+            ln = (p >> 4) & 0x3FFFFFFF
+            for _ in range(ln):
+                rows.append((2, 0))
+        elif op == C.CDEL:
+            ln = (p >> 4) & 0x3FFFFFFF
+            for _ in range(ln):
+                rows.append((1, 0))
+    n = min(len(rows), max_ops)
+    ops = np.zeros((max_ops, 2), dtype=np.int32)
+    if n:
+        ops[:n] = rows[:n]
+    return ops, n

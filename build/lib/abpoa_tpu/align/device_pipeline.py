@@ -1,0 +1,195 @@
+"""Prototype all-device progressive POA loop (round 1).
+
+SUPERSEDED by align/fused_loop.py, which wraps the whole read set in one
+jitted while_loop with banded storage, capacity growth, int16 promotion and
+an optional Pallas kernel; this module remains as the readable stepping-stone
+design and is still covered by tests/test_device_pipeline.py.
+
+Composes the device-resident pieces end-to-end for plain (unseeded) global
+progressive POA:
+
+  topo_sort (device) -> kernel tables BUILT ON DEVICE from the dense graph
+  arrays (pure gathers, no host walk) -> _dp_full (scan + best + backtrack on
+  device) -> fuse_alignment (device)
+
+The per-read loop performs NO host synchronization: the backtrack op stream is
+reversed into fusion order on device (`reverse_ops_device`), band/sink scalars
+stay traced, and the Python loop only enqueues async dispatches. Overflow/error
+flags are checked once at the end. Round 2 wraps the loop in a single jitted
+`lax.while_loop` to also amortize per-dispatch overhead (see PERF.md).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..params import Params
+from .device_graph import (DeviceGraph, fuse_alignment, init_device_graph,
+                           topo_sort)
+from .jax_backend import _bucket, _dp_full
+from .oracle import INT32_MIN, dp_inf_min
+
+
+@jax.jit
+def build_tables_device(g: DeviceGraph, i2n, n2i, remain):
+    """Kernel tables as pure gathers over the dense graph arrays."""
+    N, E = g.in_ids.shape
+    n = g.node_n
+    rows = jnp.arange(N, dtype=jnp.int32)
+    nid = i2n  # topo row -> node id
+    base = g.base[nid]
+    # predecessors of row i = topo indices of in-edges of its node
+    pre_idx = n2i[g.in_ids[nid]]                       # (N, E)
+    pre_msk = jnp.arange(E)[None, :] < g.in_cnt[nid][:, None]
+    pre_msk = pre_msk & (rows[:, None] > 0) & (rows[:, None] < n)
+    out_idx = n2i[g.out_ids[nid]]
+    out_msk = jnp.arange(E)[None, :] < g.out_cnt[nid][:, None]
+    out_msk = out_msk & (rows[:, None] > 0) & (rows[:, None] < n - 1)
+    row_active = (rows > 0) & (rows < n - 1)
+    remain_rows = remain[nid]
+    # fresh adaptive-band state (the reference re-inits in topological_sort)
+    mpl0 = jnp.full(N, n, jnp.int32).at[0].set(0)
+    mpr0 = jnp.zeros(N, jnp.int32)
+    # first-row seeding: out-neighbors of the source row get mpl=mpr=1
+    src_out = out_idx[0]
+    src_m = jnp.arange(E) < g.out_cnt[nid[0]]
+    tgt = jnp.where(src_m, src_out, N - 1)
+    mpl0 = mpl0.at[tgt].set(jnp.where(src_m, 1, mpl0[tgt]))
+    mpr0 = mpr0.at[tgt].set(jnp.where(src_m, 1, mpr0[tgt]))
+    return (base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+            remain_rows, mpl0, mpr0)
+
+
+@jax.jit
+def reverse_ops_device(ops, n_ops, best_j, fin_j, qlen, i2n):
+    """Backtrack emits ops from the alignment end backwards; fusion consumes
+    them forward with head/tail insertions for unaligned query ends. Runs on
+    device — no host roundtrip between backtrack and fusion."""
+    max_ops = ops.shape[0]
+    k = jnp.arange(max_ops, dtype=jnp.int32)
+    head = fin_j                       # leading INS count
+    mid = head + n_ops                 # reversed op-stream region
+    n_fwd = mid + (qlen - best_j)      # + trailing INS
+    src = jnp.clip(n_ops - 1 - (k - head), 0, max_ops - 1)
+    in_mid = (k >= head) & (k < mid)
+    op = jnp.where(in_mid, ops[src, 0], 2)
+    # map dp-row argument to node id for match/del ops
+    arg = jnp.where(in_mid, i2n[jnp.clip(ops[src, 1], 0, i2n.shape[0] - 1)], 0)
+    fwd = jnp.stack([jnp.where(k < n_fwd, op, 0),
+                     jnp.where(k < n_fwd, arg, 0)], axis=1)
+    return fwd, n_fwd
+
+
+def progressive_poa_device(seqs: List[np.ndarray], abpt: Params,
+                           N: int = 1024, E: int = 8, A: int = 4
+                           ) -> DeviceGraph:
+    """Run plain progressive POA with all graph/DP state on device.
+
+    Returns the final (topo-sorted) DeviceGraph; raises on capacity overflow.
+    Requires global mode + banded + convex/affine/linear without path scores.
+    """
+    assert abpt.align_mode == C.GLOBAL_MODE and not abpt.inc_path_score
+    inf_min = dp_inf_min(abpt)
+    banded = abpt.wb >= 0
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+
+    g = init_device_graph(N, E, A)
+    i2n = n2i = remain = None
+    err_any = jnp.bool_(False)
+    for read_id, seq in enumerate(seqs):
+        qlen = len(seq)
+        Qp = _bucket(qlen + 1, 128)
+        max_ops = N + Qp + 8
+        wpad = np.ones(N, dtype=np.int32)
+        qpad = np.zeros(N, dtype=np.int32)
+        qpad[:qlen] = seq
+        if read_id == 0:  # seed the empty graph
+            ops = jnp.zeros((max_ops, 2), jnp.int32)
+            g = fuse_alignment(g, ops, jnp.int32(0), jnp.asarray(qpad),
+                               jnp.int32(qlen), jnp.asarray(wpad),
+                               C.SRC_NODE_ID, C.SINK_NODE_ID, max_ops=max_ops)
+            g, i2n, n2i, remain, ok = topo_sort(g)
+            continue
+
+        # --- everything below is async device work: no host sync per read ---
+        base, pre_idx, pre_msk, out_idx, out_msk, row_active, remain_rows, \
+            mpl0, mpr0 = build_tables_device(g, i2n, n2i, remain)
+
+        w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
+        remain_end = remain[C.SINK_NODE_ID]
+        r0 = qlen - (remain_rows[0] - remain_end - 1)
+        dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w) if banded \
+            else jnp.int32(qlen)
+
+        qp = np.zeros((abpt.m, Qp), dtype=np.int32)
+        qp[:, 1: qlen + 1] = mat[:, seq]
+        sink_rows = pre_idx[g.node_n - 1]
+        sink_msk = pre_msk[g.node_n - 1]
+
+        packed = _dp_full(
+            base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+            remain_rows, mpl0, mpr0, jnp.asarray(qp),
+            jnp.asarray(seq.astype(np.int32)), jnp.asarray(mat),
+            sink_rows, sink_msk,
+            jnp.int32(qlen), jnp.int32(w), remain_end.astype(jnp.int32),
+            jnp.int32(inf_min), dp_end0.astype(jnp.int32),
+            jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+            jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+            jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
+            gap_mode=abpt.gap_mode, local=False, banded=banded,
+            n_steps=N - 1, align_mode=C.GLOBAL_MODE,
+            gap_on_right=bool(abpt.put_gap_on_right),
+            put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
+            ret_cigar=True)
+        n_ops = packed[0]
+        fin_j = packed[2]
+        err_any = err_any | (packed[7] != 0)
+        best_j = packed[10]
+        ops = packed[11 + 2 * N:].reshape(max_ops, 2)
+        fwd_ops, n_fwd = reverse_ops_device(ops, n_ops, best_j, fin_j,
+                                            jnp.int32(qlen), i2n)
+        g = fuse_alignment(g, fwd_ops, n_fwd, jnp.asarray(qpad),
+                           jnp.int32(qlen), jnp.asarray(wpad),
+                           C.SRC_NODE_ID, C.SINK_NODE_ID, max_ops=max_ops)
+        g, i2n, n2i, remain, ok = topo_sort(g)
+    # one sync at the end of the read set
+    if bool(err_any):
+        raise RuntimeError("device backtrack failed in device pipeline")
+    if not bool(g.ok):
+        raise RuntimeError("device graph capacity overflow")
+    return g
+
+
+def device_graph_to_python(g: DeviceGraph, abpt: Params):
+    """Materialize a host POAGraph (for consensus/output) from device arrays."""
+    from ..graph import POAGraph, Node
+    n = int(g.node_n)
+    base = np.asarray(g.base)
+    in_ids = np.asarray(g.in_ids)
+    in_w = np.asarray(g.in_w)
+    in_cnt = np.asarray(g.in_cnt)
+    out_ids = np.asarray(g.out_ids)
+    out_w = np.asarray(g.out_w)
+    out_cnt = np.asarray(g.out_cnt)
+    aligned = np.asarray(g.aligned)
+    aligned_cnt = np.asarray(g.aligned_cnt)
+    n_read = np.asarray(g.n_read)
+    pg = POAGraph()
+    pg.nodes = []
+    for i in range(n):
+        nd = Node(i, int(base[i]))
+        nd.in_ids = [int(x) for x in in_ids[i][: in_cnt[i]]]
+        nd.in_w = [int(x) for x in in_w[i][: in_cnt[i]]]
+        nd.out_ids = [int(x) for x in out_ids[i][: out_cnt[i]]]
+        nd.out_w = [int(x) for x in out_w[i][: out_cnt[i]]]
+        nd.read_ids = [0] * len(nd.out_ids)
+        nd.aligned_ids = [int(x) for x in aligned[i][: aligned_cnt[i]]]
+        nd.n_read = int(n_read[i])
+        pg.nodes.append(nd)
+    pg.topological_sort(abpt)
+    return pg

@@ -1,0 +1,79 @@
+"""Backend dispatch for the DP kernel.
+
+TPU-native analog of the reference's runtime CPUID dispatch
+(/root/reference/src/abpoa_dispatch_simd.c:59-82): the `device` field of
+`Params` selects the kernel implementation. "numpy" is the host oracle;
+"jax"/"pallas" run the banded DP on the accelerator (registered lazily so the
+package imports without a TPU present).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from .. import constants as C
+from ..graph import POAGraph
+from ..params import Params
+from .oracle import align_sequence_to_subgraph_numpy
+from .result import AlignResult
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_backend(name: str, fn: Callable) -> None:
+    _BACKENDS[name] = fn
+
+
+register_backend("numpy", align_sequence_to_subgraph_numpy)
+
+
+def _resolve(abpt: Params) -> Callable:
+    name = abpt.device
+    if name in _BACKENDS:
+        return _BACKENDS[name]
+    if name in ("jax", "tpu", "pallas", "native"):
+        if name == "native":
+            from . import native_backend  # registers "native"
+        else:
+            from . import jax_backend  # lazy: registers "jax"
+            if name == "pallas":
+                from . import pallas_backend  # registers "pallas"
+            if name == "tpu":
+                name = "jax"
+        if name in _BACKENDS:
+            return _BACKENDS[name]
+    raise ValueError(f"Unknown DP backend: {abpt.device}")
+
+
+def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
+                               end_node_id: int, query: np.ndarray) -> AlignResult:
+    if g.node_n <= 2:  # empty graph: nothing to align to (abpoa_align.c:196)
+        return AlignResult()
+    if not g.is_topological_sorted:
+        g.topological_sort(abpt)
+    return _resolve(abpt)(g, abpt, beg_node_id, end_node_id, query)
+
+
+def align_windows(g: POAGraph, abpt: Params, windows) -> list:
+    """Align independent subgraph windows [(beg_id, end_id, query), ...].
+
+    Device backends batch all windows into one dispatch
+    (jax_backend.align_windows_jax); host backends run them sequentially.
+    Results are identical either way.
+    """
+    if not windows:
+        return []
+    if g.node_n <= 2:
+        return [AlignResult() for _ in windows]
+    if not g.is_topological_sorted:
+        g.topological_sort(abpt)
+    fn = _resolve(abpt)  # also validates the backend name
+    if len(windows) > 1 and abpt.device in ("jax", "tpu", "pallas"):
+        from .jax_backend import align_windows_jax
+        return align_windows_jax(g, abpt, windows)
+    return [fn(g, abpt, b, e, q) for b, e, q in windows]
+
+
+def align_sequence_to_graph(g: POAGraph, abpt: Params, query: np.ndarray) -> AlignResult:
+    return align_sequence_to_subgraph(g, abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, query)

@@ -1,0 +1,1317 @@
+"""Single-dispatch all-device progressive POA.
+
+The round-1 device path paid ~140 ms of link latency per read (one dispatch +
+one download). This module removes the per-read link round-trips entirely: the
+whole progressive loop — banded DP, device backtrack, cigar fusion, topological
+order maintenance, band metadata — runs inside ONE jitted `lax.while_loop` over
+the read set. The host uploads the padded read batch once and downloads the
+final graph once; consensus/MSA generation stays on host (cheap, and needs the
+reference's exact output walk anyway).
+
+Design notes (what is different from the reference, and why it is safe):
+
+- Banded plane storage. The reference allocates full-width DP rows and computes
+  only the adaptive band segment (/root/reference/src/abpoa_align_simd.c:946-959).
+  Here each row stores exactly one W-wide window starting at its band begin;
+  predecessor cells are fetched by per-row window-relative gathers. Cells
+  outside a row's band are -inf in both designs, so results are identical while
+  HBM footprint drops from O(rows x qlen) to O(rows x W).
+
+- Topological order maintenance by splicing, not per-read BFS. The reference
+  re-runs a Kahn BFS after every fusion (/root/reference/src/abpoa_graph.c:322-357)
+  because it is cheap in C. A sequential BFS on the TPU scalar core would
+  dominate the loop, and — key observation — none of the DP/backtrack/fusion
+  semantics depend on WHICH valid topological order is used: every tie-break in
+  the kernel rides edge-slot order (weight-sorted, maintained exactly) or
+  column positions, never the topo position of a node. Because backtrack paths
+  walk rows in strictly increasing topo position, all new nodes of a read can
+  be spliced into the existing order right after their path predecessor, a pure
+  vectorized operation. Edges introduced by aligned-node reuse can (rarely)
+  violate the spliced order; the loop detects this and falls back to the exact
+  device Kahn sort (device_graph.topo_sort) for that read. The final
+  host-side output pass re-runs the reference BFS order on the downloaded
+  graph, so all emitted bytes match the reference exactly.
+
+- max_remain by pointer doubling. remain[v] is the length of the
+  heaviest-out-edge chain from v to the sink (abpoa_graph.c:268-309) — a
+  function of the graph only. The chain pointers (slot 0 after the weight sort)
+  form a forest into the sink, so remain is computed with log2(N) rounds of
+  pointer jumping instead of a sequential reverse BFS.
+
+- Vectorized fusion. One read's backtrack ops touch each graph node at most
+  once (the alignment is a path), so all edge appends/reweights hit distinct
+  slots and are scattered in parallel; new node ids are assigned by prefix sums
+  (matching the reference's sequential allocation order,
+  abpoa_graph.c:689-774). The only sequential hazard — two mismatch columns of
+  the same read interacting with the same aligned-node group — is detected (by
+  group-root collision counting) and routed to the sequential in-jit fusion
+  fallback (device_graph.fuse_alignment).
+
+Capacities (N nodes, E edge slots, W band window, Qp padded query) are static;
+the loop exits with an error code when one is exceeded and the host wrapper
+grows the bucket and resumes from the returned device state (no work is lost).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import constants as C
+from ..params import Params
+from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
+from .jax_backend import _bucket, _bucket_pow2
+from .oracle import (INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit,
+                     max_score_bound)
+
+# error codes reported by the fused loop (state.err)
+ERR_OK = 0
+ERR_NODE_CAP = 1     # node capacity N exhausted -> grow N
+ERR_BAND_CAP = 2     # band wider than W -> grow W
+ERR_EDGE_CAP = 3     # edge slots E exhausted -> grow E
+ERR_BACKTRACK = 4    # device backtrack diverged (bug) -> host fallback
+ERR_OPS_CAP = 5      # op stream longer than max_ops -> grow N (max_ops tracks N)
+ERR_ALIGN_CAP = 6    # aligned-group slots A exhausted -> grow A (aa alphabets)
+ERR_GRAPH_CAP = 7    # capacity hit inside the sequential fusion/Kahn fallback
+#                      (no specific dimension reported) -> grow N, E and A
+ERR_PROMOTE = 8      # int16 score bound exceeded -> switch planes to int32
+
+
+class FusedState(NamedTuple):
+    g: DeviceGraph
+    order: jnp.ndarray    # (N,) topo index -> node id
+    n2i: jnp.ndarray      # (N,) node id -> topo index
+    remain: jnp.ndarray   # (N,) max_remain per node id
+    read_idx: jnp.ndarray  # () int32: number of reads fused so far
+    err: jnp.ndarray      # () int32 error code
+    kahn_runs: jnp.ndarray  # () int32: spliced-order violations repaired
+    paths: jnp.ndarray    # (n_reads, Pcap) each read's fusion path node ids
+    path_lens: jnp.ndarray  # (n_reads,)
+    collisions: jnp.ndarray  # () int32: sequential-fusion fallbacks taken
+
+
+def init_fused_state(N: int, E: int, A: int, n_reads: int = 1,
+                     Pcap: int = 8) -> FusedState:
+    return FusedState(
+        g=init_device_graph(N, E, A),
+        order=jnp.zeros(N, jnp.int32),
+        n2i=jnp.zeros(N, jnp.int32),
+        remain=jnp.zeros(N, jnp.int32),
+        read_idx=jnp.int32(0),
+        err=jnp.int32(ERR_OK),
+        kahn_runs=jnp.int32(0),
+        paths=jnp.zeros((n_reads, Pcap), jnp.int32),
+        path_lens=jnp.zeros(n_reads, jnp.int32),
+        collisions=jnp.int32(0))
+
+
+# --------------------------------------------------------------------------- #
+# graph-order utilities                                                       #
+# --------------------------------------------------------------------------- #
+
+def _edge_sort(g: DeviceGraph) -> DeviceGraph:
+    """Weight-descending exchange sort of every node's edge slots — the exact
+    (unstable) tie behavior of the reference (abpoa_graph.c:192-219)."""
+    E = g.in_ids.shape[1]
+
+    def sort_node(ids, w, cnt):
+        def outer(j, st):
+            ids, w = st
+
+            def inner(k, st):
+                ids, w = st
+                swap = (k < cnt) & (w[j] < w[k])
+                wj, wk = w[j], w[k]
+                ij, ik = ids[j], ids[k]
+                w = w.at[j].set(jnp.where(swap, wk, wj)).at[k].set(jnp.where(swap, wj, wk))
+                ids = ids.at[j].set(jnp.where(swap, ik, ij)).at[k].set(jnp.where(swap, ij, ik))
+                return ids, w
+            return lax.fori_loop(j + 1, E, inner, st)
+        return lax.fori_loop(0, E, outer, (ids, w))
+
+    in_ids, in_w = jax.vmap(sort_node)(g.in_ids, g.in_w, g.in_cnt)
+    out_ids, out_w = jax.vmap(sort_node)(g.out_ids, g.out_w, g.out_cnt)
+    return g._replace(in_ids=in_ids, in_w=in_w, out_ids=out_ids, out_w=out_w)
+
+
+def _remain_doubling(g: DeviceGraph) -> jnp.ndarray:
+    """max_remain via pointer jumping over the heaviest-out-edge forest.
+
+    remain[sink] = -1; remain[v] = remain[argmax-w out-edge] + 1
+    (slot 0 after the weight sort picks the same edge as the reference's
+    strict-> scan, abpoa_graph.c:196-205). Values equal the reference's
+    reverse-BFS results because remain is a pure graph function.
+    """
+    N = g.base.shape[0]
+    nodes = jnp.arange(N, dtype=jnp.int32)
+    active = nodes < g.node_n
+    ptr = jnp.where(active & (nodes != C.SINK_NODE_ID), g.out_ids[:, 0],
+                    C.SINK_NODE_ID).astype(jnp.int32)
+    ptr = ptr.at[C.SINK_NODE_ID].set(C.SINK_NODE_ID)
+    steps = jnp.where(nodes == C.SINK_NODE_ID, 0, 1).astype(jnp.int32)
+    n_rounds = max(1, int(N - 1).bit_length())
+    for _ in range(n_rounds):
+        steps = steps + steps[ptr]
+        ptr = ptr[ptr]
+    return jnp.where(active, steps - 1, 0).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------- #
+# banded DP over graph rows                                                   #
+# --------------------------------------------------------------------------- #
+
+def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf):
+    """Row-0 (source row) plane windows for the convex-global regime
+    (abpoa_align_simd.c:582-688). Single source of truth — used by both
+    _dp_banded's init and the Pallas path. Dtype follows the scalars."""
+    dt = jnp.asarray(o1).dtype
+    kw = jnp.arange(W, dtype=jnp.int32)
+    kw_dt = kw.astype(dt)
+    colv = kw <= dp_end0
+    f1r = -o1 - e1 * kw_dt
+    f2r = -o2 - e2 * kw_dt
+    F10 = jnp.where(colv & (kw >= 1), f1r, inf)
+    F20 = jnp.where(colv & (kw >= 1), f2r, inf)
+    H0 = jnp.where(colv & (kw >= 1), jnp.maximum(f1r, f2r), inf).at[0].set(0)
+    E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+    E20 = jnp.full(W, inf, dt).at[0].set(-oe2)
+    return H0, E10, E20, F10, F20
+
+@functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16"))
+def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+               remain_rows, mpl0, mpr0, qp, n_rows,
+               qlen, w, remain_end, inf_min, dp_end0,
+               o1, e1, oe1, o2, e2, oe2,
+               gap_mode: int, W: int, plane16: bool = False):
+    """Adaptive-banded DP with W-wide windowed plane storage.
+
+    Row i stores plane cells for absolute columns [dp_beg[i], dp_beg[i]+W);
+    in-band cells outside [dp_beg, dp_end] and window cells past dp_end are
+    -inf, matching the reference full-width semantics
+    (/root/reference/src/abpoa_align_simd.c:935-1074, band macros
+    src/abpoa_align.h:34-35). Global mode only (the fused loop's scope).
+
+    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, mpl, mpr, band_overflow).
+    """
+    R = base_r.shape[0]
+    P = pre_idx.shape[1]
+    # int16 planes double the effective VPU lanes when the score bound allows
+    # (the reference's width promotion, abpoa_align_simd.c:1293-1302)
+    dt = jnp.int16 if plane16 else jnp.int32
+    inf = inf_min.astype(dt)
+    o1, e1, oe1, o2, e2, oe2 = [x.astype(dt) for x in (o1, e1, oe1, o2, e2, oe2)]
+    qp = qp.astype(dt)
+    convex = gap_mode == C.CONVEX_GAP
+    linear = gap_mode == C.LINEAR_GAP
+    kw = jnp.arange(W, dtype=jnp.int32)
+    kw_dt = kw.astype(dt)
+
+    # ---- first row: absolute cols [0, dp_end0] ------------------------------
+    colv = kw <= dp_end0
+    if linear:
+        H0 = jnp.where(colv, -e1 * kw_dt, inf)
+        E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
+    elif convex:
+        H0, E10, E20, F10, F20 = _row0_planes(
+            W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf)
+    else:
+        f1r = -o1 - e1 * kw_dt
+        F10 = jnp.where(colv & (kw >= 1), f1r, inf)
+        F20 = jnp.full(W, inf, dt)
+        H0 = jnp.where(colv & (kw >= 1), f1r, inf).at[0].set(0)
+        E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+        E20 = jnp.full(W, inf, dt)
+
+    Hb = jnp.full((R, W), inf, dt).at[0].set(H0)
+    E1b = jnp.full((R, W), inf, dt).at[0].set(E10)
+    E2b = jnp.full((R, W), inf, dt).at[0].set(E20)
+    F1b = jnp.full((R, W), inf, dt).at[0].set(F10)
+    F2b = jnp.full((R, W), inf, dt).at[0].set(F20)
+    dp_beg = jnp.zeros(R, jnp.int32)
+    dp_end = jnp.zeros(R, jnp.int32).at[0].set(dp_end0)
+    mpl = jnp.concatenate([mpl0, jnp.zeros(1, jnp.int32)])
+    mpr = jnp.concatenate([mpr0, jnp.zeros(1, jnp.int32)])
+
+    n_chain_steps = max(1, (W - 1).bit_length())
+
+    def chain_max(A, ext):
+        # F[k] = max_d (A[k-d] - d*ext), log-step doubling within the window
+        F = A
+        shift = 1
+        for _ in range(n_chain_steps):
+            prev = jnp.concatenate([jnp.full(shift, inf, dt), F[:-shift]])
+            shifted = jnp.maximum(prev, inf + shift * ext) - shift * ext
+            F = jnp.maximum(F, shifted)
+            shift <<= 1
+            if shift >= W:
+                break
+        return F
+
+    def pre_window(plane, dp_beg_cur, pidx, pm, abs_cols, inf):
+        """Gather predecessor plane cells at absolute columns (P, W).
+
+        dp_beg_cur must be the loop-carried band begins (NOT the initial
+        array) so each predecessor row's window offset is current."""
+        pw = plane[pidx]                                   # (P, W)
+        idx = abs_cols[None, :] - dp_beg_cur[pidx][:, None]  # (P, W) window index
+        ok = pm[:, None] & (idx >= 0) & (idx < W)
+        v = jnp.take_along_axis(pw, jnp.clip(idx, 0, W - 1), axis=1)
+        return jnp.where(ok, v, inf)
+
+    def body(st):
+        (i, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
+        active = row_active[i]
+        pm = pre_msk[i]
+        pidx = pre_idx[i]
+
+        # ---- band ----------------------------------------------------------
+        r = qlen - (remain_rows[i] - remain_end - 1)
+        beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
+        end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
+        min_pre_beg = jnp.min(jnp.where(pm, dp_beg[pidx], jnp.int32(2**30)))
+        beg = jnp.maximum(beg, min_pre_beg)
+        overflow = overflow | (active & (end - beg + 1 > W))
+        abs_cols = beg + kw
+        in_band = abs_cols <= end
+
+        # ---- M / E from predecessors --------------------------------------
+        Hm1 = pre_window(Hb, dp_beg, pidx, pm, abs_cols - 1, inf)  # H[pre][j-1]
+        # the lead cell (absolute col -1) of a predecessor row never exists;
+        # global first col handled by row-0 init, so OOB stays inf
+        Mq = jnp.max(Hm1, axis=0)
+        if linear:
+            Hj = pre_window(Hb, dp_beg, pidx, pm, abs_cols, inf)
+            Erow = jnp.max(Hj - e1, axis=0)
+        else:
+            Erow = jnp.max(pre_window(E1b, dp_beg, pidx, pm, abs_cols, inf), axis=0)
+            if convex:
+                E2row = jnp.max(pre_window(E2b, dp_beg, pidx, pm, abs_cols, inf), axis=0)
+
+        Mq = Mq + qp[base_r[i], jnp.clip(abs_cols, 0, qp.shape[1] - 1)]
+        Mq = jnp.where(in_band, Mq, inf)
+        Erow = jnp.where(in_band, Erow, inf)
+        Hhat = jnp.maximum(Mq, Erow)
+        if convex:
+            E2row = jnp.where(in_band, E2row, inf)
+            Hhat = jnp.maximum(Hhat, E2row)
+
+        if linear:
+            Hrow = chain_max(Hhat, e1)
+            Hrow = jnp.where(in_band, Hrow, inf)
+            E1n = E2n = F1n = F2n = jnp.full(W, inf, dt)
+        else:
+            Hm1w = jnp.concatenate([jnp.full(1, inf, dt), Hhat[:-1]])
+            A1 = jnp.where(kw == 0, Mq - oe1, Hm1w - oe1)
+            A1 = jnp.where(in_band, A1, inf)
+            F1n = chain_max(A1, e1)
+            Hrow = jnp.maximum(Hhat, F1n)
+            if convex:
+                A2 = jnp.where(kw == 0, Mq - oe2, Hm1w - oe2)
+                A2 = jnp.where(in_band, A2, inf)
+                F2n = chain_max(A2, e2)
+                Hrow = jnp.maximum(Hrow, F2n)
+            else:
+                F2n = jnp.full(W, inf, dt)
+            if gap_mode == C.AFFINE_GAP:
+                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                E2n = jnp.full(W, inf, dt)
+            else:
+                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                E2n = jnp.maximum(E2row - e2, Hrow - oe2)
+            E1n = jnp.where(in_band, E1n, inf)
+            E2n = jnp.where(in_band, E2n, inf)
+            F1n = jnp.where(in_band, F1n, inf)
+            F2n = jnp.where(in_band, F2n, inf)
+            Hrow = jnp.where(in_band, Hrow, inf)
+
+        # ---- row max -> adaptive band propagation --------------------------
+        vals = jnp.where(in_band, Hrow, inf)
+        mx = jnp.max(vals)
+        has = mx > inf
+        eq = (vals == mx) & in_band
+        left = jnp.where(has, beg + jnp.argmax(eq), -1).astype(jnp.int32)
+        right = jnp.where(has, beg + W - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
+        om = out_msk[i] & active
+        tgt = jnp.where(om, out_idx[i], R)
+        mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
+        mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
+
+        # ---- commit --------------------------------------------------------
+        keep = active
+        Hb = Hb.at[i].set(jnp.where(keep, Hrow, Hb[i]))
+        if not linear:
+            E1b = E1b.at[i].set(jnp.where(keep, E1n, E1b[i]))
+            F1b = F1b.at[i].set(jnp.where(keep, F1n, F1b[i]))
+            if convex:
+                E2b = E2b.at[i].set(jnp.where(keep, E2n, E2b[i]))
+                F2b = F2b.at[i].set(jnp.where(keep, F2n, F2b[i]))
+        dp_beg = dp_beg.at[i].set(jnp.where(keep, beg, dp_beg[i]))
+        dp_end = dp_end.at[i].set(jnp.where(keep, end, dp_end[i]))
+        return (i + 1, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow)
+
+    def cond(st):
+        i = st[0]
+        overflow = st[-1]
+        return (i < n_rows - 1) & (~overflow)
+
+    st = (jnp.int32(1), Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+          jnp.bool_(False))
+    st = lax.while_loop(cond, body, st)
+    (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
+    return Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1], overflow
+
+
+# --------------------------------------------------------------------------- #
+# windowed device backtrack                                                   #
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "gap_on_right", "put_gap_at_end", "max_ops"))
+def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
+                 base_r, query_pad, mat, best_i, best_j,
+                 e1, oe1, e2, oe2, inf_min,
+                 gap_mode: int, gap_on_right: bool, put_gap_at_end: bool,
+                 max_ops: int):
+    """Backtrack over windowed planes (global mode).
+
+    Mirrors jax_backtrack.device_backtrack but indexes plane cell (i, j) at
+    window position j - dp_beg[i]; out-of-window cells read as -inf, which is
+    exactly their full-width value. Op priority chain replicates
+    /root/reference/src/abpoa_align_simd.c:309-458.
+    """
+    dt = H.dtype
+    mat = mat.astype(dt)
+    e1, oe1, e2, oe2 = [x.astype(dt) for x in (e1, oe1, e2, oe2)]
+    inf_min = inf_min.astype(dt)
+    R, W = H.shape
+    P = pre_idx.shape[1]
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+    i32 = jnp.int32
+    inf = inf_min
+
+    def gat(A, i, j):
+        k = j - dp_beg[i]
+        ok = (k >= 0) & (k < W) & (j <= dp_end[i])
+        row = lax.dynamic_index_in_dim(A, i, 0, keepdims=False)
+        v = lax.dynamic_index_in_dim(row, jnp.clip(k, 0, W - 1), 0, keepdims=False)
+        return jnp.where(ok, v, inf)
+
+    def gat_rows(A, rows, j):
+        k = j - dp_beg[rows]
+        ok = (k >= 0) & (k < W)
+        v = jnp.take_along_axis(A[rows], jnp.clip(k, 0, W - 1)[:, None],
+                                axis=1)[:, 0]
+        return jnp.where(ok, v, inf)
+
+    def cond(st):
+        i, j, *_, err, done = st
+        return (i > 0) & (j > 0) & (~err) & (~done)
+
+    def body(st):
+        (i, j, cur_op, look_gap, n_ops, ops, n_aln, n_match, err, done) = st
+        H_ij = gat(H, i, j)
+        s = mat[base_r[i], query_pad[j - 1]]
+        is_match = (base_r[i] == query_pad[j - 1]).astype(i32)
+
+        pidx = pre_idx[i]
+        pmsk = pre_msk[i]
+        Hp_jm1 = gat_rows(H, pidx, j - 1)
+        Hp_j = gat_rows(H, pidx, j)
+        beg_p = dp_beg[pidx]
+        end_p = dp_end[pidx]
+        inb_m = (j - 1 >= beg_p) & (j - 1 <= end_p) & pmsk
+        inb_e = (j >= beg_p) & (j <= end_p) & pmsk
+
+        m_hit = inb_m & (Hp_jm1 + s == H_ij)
+        any_m = jnp.any(m_hit)
+        first_m = jnp.argmax(m_hit).astype(i32)
+        has_M = (cur_op & C.M_OP) != 0
+
+        if linear:
+            m1 = any_m & (look_gap == 0) if not gap_on_right else jnp.bool_(False)
+        else:
+            m1 = any_m & has_M & (look_gap == 0) if not gap_on_right else jnp.bool_(False)
+
+        # ---------- deletion ----------
+        if linear:
+            d_hit = inb_e & (Hp_j - e1 == H_ij)
+            any_d = jnp.any(d_hit)
+            first_d = jnp.argmax(d_hit).astype(i32)
+            d_new_op = i32(C.ALL_OP)
+        else:
+            E1_ij = gat(E1, i, j)
+            E1p_j = gat_rows(E1, pidx, j)
+            has_E1 = (cur_op & C.E1_OP) != 0
+            c1 = jnp.where(has_M, H_ij == E1p_j, E1_ij == E1p_j - e1)
+            hit1 = inb_e & c1 & has_E1
+            if convex:
+                E2_ij = gat(E2, i, j)
+                E2p_j = gat_rows(E2, pidx, j)
+                has_E2 = (cur_op & C.E2_OP) != 0
+                c2 = jnp.where(has_M, H_ij == E2p_j, E2_ij == E2p_j - e2)
+                hit2 = inb_e & c2 & has_E2
+            else:
+                hit2 = jnp.zeros_like(hit1)
+            slot_hit = hit1 | hit2
+            any_d = jnp.any(slot_hit)
+            first_d = jnp.argmax(slot_hit).astype(i32)
+            use_e1 = hit1[first_d]
+            pe1 = E1p_j[first_d]
+            ph = Hp_j[first_d]
+            op_e1 = jnp.where(ph - oe1 == pe1, i32(C.M_OP | C.F_OP), i32(C.E1_OP))
+            if convex:
+                pe2 = E2p_j[first_d]
+                op_e2 = jnp.where(ph - oe2 == pe2, i32(C.M_OP | C.F_OP), i32(C.E2_OP))
+            else:
+                op_e2 = i32(C.E1_OP)
+            d_new_op = jnp.where(use_e1, op_e1, op_e2)
+
+        # ---------- insertion ----------
+        if linear:
+            H_ijm1 = gat(H, i, j - 1)
+            ins_hit = H_ijm1 - e1 == H_ij
+            ins_new_op = i32(C.ALL_OP)
+        else:
+            F1_ij = gat(F1, i, j)
+            F1_ijm1 = gat(F1, i, j - 1)
+            H_ijm1 = gat(H, i, j - 1)
+            has_F1 = (cur_op & C.F1_OP) != 0
+            f1_open = H_ijm1 - oe1 == F1_ij
+            f1_ext = F1_ijm1 - e1 == F1_ij
+            f1_gate = jnp.where(has_M, H_ij == F1_ij, True)
+            f1_hit = has_F1 & f1_gate & (f1_open | f1_ext)
+            f1_op = jnp.where(f1_open, i32(C.M_OP | C.E_OP), i32(C.F1_OP))
+            if convex:
+                F2_ij = gat(F2, i, j)
+                F2_ijm1 = gat(F2, i, j - 1)
+                has_F2 = (cur_op & C.F2_OP) != 0
+                f2_open = H_ijm1 - oe2 == F2_ij
+                f2_ext = F2_ijm1 - e2 == F2_ij
+                f2_gate = jnp.where(has_M, H_ij == F2_ij, True)
+                f2_hit = has_F2 & f2_gate & (f2_open | f2_ext)
+                f2_op = jnp.where(f2_open, i32(C.M_OP | C.E_OP), i32(C.F2_OP))
+            else:
+                f2_hit = jnp.bool_(False)
+                f2_op = i32(C.ALL_OP)
+            ins_hit = f1_hit | f2_hit
+            ins_new_op = jnp.where(f1_hit, f1_op, f2_op)
+
+        m2 = any_m if linear else (any_m & has_M)
+
+        d_sel = (~m1) & any_d
+        i_sel = (~m1) & (~d_sel) & ins_hit
+        m2_sel = (~m1) & (~d_sel) & (~i_sel) & m2
+        no_hit = (~m1) & (~d_sel) & (~i_sel) & (~m2)
+        m_sel = m1 | m2_sel
+
+        op_code = jnp.where(m_sel, 0, jnp.where(d_sel, 1, 2))
+        ops = ops.at[n_ops, 0].set(jnp.where(no_hit, ops[n_ops, 0], op_code))
+        ops = ops.at[n_ops, 1].set(jnp.where(no_hit, ops[n_ops, 1], i))
+
+        pre_m = pidx[first_m]
+        pre_d = pidx[first_d]
+        new_i = jnp.where(m_sel, pre_m, jnp.where(d_sel, pre_d, i))
+        new_j = jnp.where(m_sel | i_sel, j - 1, j)
+        new_op = jnp.where(m_sel, i32(C.ALL_OP),
+                           jnp.where(d_sel, d_new_op,
+                                     jnp.where(i_sel, ins_new_op, cur_op)))
+        new_look = jnp.where(m1, look_gap,
+                             jnp.where(d_sel | i_sel | m2_sel, i32(0), look_gap))
+        new_naln = n_aln + jnp.where(m_sel | i_sel, 1, 0)
+        new_nmatch = n_match + jnp.where(m_sel, is_match, 0)
+        adv = ~no_hit
+        cap = n_ops + 1 >= max_ops
+        return ((jnp.where(adv, new_i, i)), jnp.where(adv, new_j, j),
+                jnp.where(adv, new_op, cur_op), jnp.where(adv, new_look, look_gap),
+                n_ops + jnp.where(adv, 1, 0), ops,
+                jnp.where(adv, new_naln, n_aln), jnp.where(adv, new_nmatch, n_match),
+                err | no_hit | cap, done)
+
+    ops0 = jnp.zeros((max_ops, 2), jnp.int32)
+    st0 = (best_i, best_j, i32(C.ALL_OP),
+           i32(1 if put_gap_at_end else 0), i32(0), ops0,
+           i32(0), i32(0), jnp.bool_(False), jnp.bool_(False))
+    st = lax.while_loop(cond, body, st0)
+    (i, j, _co, _lg, n_ops, ops, n_aln, n_match, err, _done) = st
+    return ops, n_ops, i, j, n_aln, n_match, err
+
+
+# --------------------------------------------------------------------------- #
+# vectorized fusion                                                           #
+# --------------------------------------------------------------------------- #
+
+def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
+                     weight):
+    """Fuse one read's forward op stream in O(1) vector steps.
+
+    fwd_op[t]: 0=match (fwd_arg = node id), 1=delete (skipped), 2=insert.
+    Safe because an alignment is a simple path: each graph node is touched at
+    most once, so every edge append/reweight lands in a distinct slot
+    (semantics: abpoa_graph.c:689-774 with inc_both_ends=1, no read-id bitsets).
+
+    Returns (g', path_nodes, path_len, path_new, collision) where collision
+    means two ops interacted with one aligned group (caller must use the
+    sequential fallback for exact reference behavior).
+    """
+    N, E = g.in_ids.shape
+    A = g.aligned.shape[1]
+    T = fwd_op.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    valid = t < n_fwd
+    is_match = valid & (fwd_op == 0)
+    is_ins = valid & (fwd_op == 2)
+    consumes = is_match | is_ins
+
+    qpos = jnp.cumsum(consumes.astype(jnp.int32)) - consumes.astype(jnp.int32)
+    qpos = jnp.clip(qpos, 0, query.shape[0] - 1)
+    b = query[qpos]
+    wt = weight[qpos]
+
+    node = jnp.clip(fwd_arg, 0, N - 1)
+    same = is_match & (g.base[node] == b)
+    # aligned lookup against PRE-read group state
+    grp_ids = g.aligned[node]                                   # (T, A)
+    grp_ok = jnp.arange(A)[None, :] < g.aligned_cnt[node][:, None]
+    grp_hit = grp_ok & (g.base[grp_ids] == b[:, None])
+    has_aln = jnp.any(grp_hit, axis=1)
+    aln_id = grp_ids[t, jnp.argmax(grp_hit, axis=1)]
+    mm = is_match & ~same
+    reuse = mm & has_aln
+    mm_new = mm & ~has_aln
+
+    # collision: two ops of this read touching the same aligned group would
+    # need sequential semantics (a node created by op k visible to op k' > k)
+    grp_root = jnp.where(
+        g.aligned_cnt[node] > 0,
+        jnp.minimum(node, jnp.min(jnp.where(grp_ok, grp_ids, N), axis=1)),
+        node).astype(jnp.int32)
+    touch = mm
+    root_cnt = jnp.zeros(N + 1, jnp.int32).at[
+        jnp.where(touch, grp_root, N)].add(1)
+    collision = jnp.any(root_cnt[:N] > 1)
+
+    is_new = is_ins | mm_new
+    new_rank = jnp.cumsum(is_new.astype(jnp.int32)) - is_new.astype(jnp.int32)
+    n_new = jnp.sum(is_new.astype(jnp.int32))
+    new_id = g.node_n + new_rank
+
+    path_node = jnp.where(same, node,
+                          jnp.where(reuse, aln_id,
+                                    jnp.where(is_new, new_id, 0))).astype(jnp.int32)
+    is_path = consumes
+    rank = jnp.cumsum(is_path.astype(jnp.int32)) - is_path.astype(jnp.int32)
+    L = jnp.sum(is_path.astype(jnp.int32))
+
+    # dense path arrays (rank-indexed, extra slot for masked scatters)
+    tgt = jnp.where(is_path, rank, T)
+    path_nodes = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
+        jnp.where(is_path, path_node, 0))
+    path_w = jnp.zeros(T + 1, jnp.int32).at[tgt].set(jnp.where(is_path, wt, 0))
+    path_new = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
+        jnp.where(is_path, is_new.astype(jnp.int32), 0))
+    path_qpos = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
+        jnp.where(is_path, qpos, 0))
+
+    # ---- new node bases + n_span (value of nearest old path node before) ----
+    nb = jnp.zeros(T + 1, jnp.int32).at[tgt].set(jnp.where(is_path, b, 0))
+    r_ = jnp.arange(T + 1, dtype=jnp.int32)
+    is_old_path = (r_ < L) & (path_new == 0)
+    last_old = jnp.maximum.accumulate(jnp.where(is_old_path, r_, -1))
+    span_src = jnp.where(last_old >= 0, path_nodes[jnp.clip(last_old, 0, T)],
+                         C.SRC_NODE_ID)
+    n_span_val = g.n_span[span_src]
+    new_sel = (r_ < L) & (path_new == 1)
+    node_tgt = jnp.where(new_sel, path_nodes, N)
+    base = jnp.pad(g.base, (0, 1))
+    base = base.at[node_tgt].set(jnp.where(new_sel, nb, base[node_tgt]))[:-1]
+    n_span = jnp.pad(g.n_span, (0, 1))
+    n_span = n_span.at[node_tgt].set(
+        jnp.where(new_sel, n_span_val, n_span[node_tgt]))[:-1]
+
+    # ---- edges: (fr, to, w, check) for ranks 0..L (L+1 edges) ---------------
+    er = jnp.arange(T + 1, dtype=jnp.int32)
+    e_valid = er <= L
+    fr = jnp.where(er == 0, C.SRC_NODE_ID, path_nodes[jnp.clip(er - 1, 0, T)])
+    to = jnp.where(er == L, C.SINK_NODE_ID, path_nodes[er])
+    wlast = weight[jnp.clip(qlen - 1, 0, weight.shape[0] - 1)]
+    ew = jnp.where(er == L, wlast, path_w[er])
+    prev_new = jnp.where(er == 0, 0, path_new[jnp.clip(er - 1, 0, T)])
+    check = (prev_new == 0)
+
+    fr_s = jnp.where(e_valid, fr, N)
+    to_s = jnp.where(e_valid, to, N)
+
+    # out-slot search on fr
+    ocnt = jnp.pad(g.out_cnt, (0, 1))
+    oids = jnp.pad(g.out_ids, ((0, 1), (0, 0)))
+    ow = jnp.pad(g.out_w, ((0, 1), (0, 0)))
+    om = (jnp.arange(E)[None, :] < ocnt[fr_s][:, None]) & (oids[fr_s] == to_s[:, None])
+    o_exists = check & jnp.any(om, axis=1) & e_valid
+    o_slot = jnp.where(o_exists, jnp.argmax(om, axis=1), ocnt[fr_s]).astype(jnp.int32)
+    edge_cap = jnp.any(e_valid & (o_slot >= E))
+    o_slot_c = jnp.clip(o_slot, 0, E - 1)
+    oids = oids.at[fr_s, o_slot_c].set(jnp.where(e_valid, to_s, oids[fr_s, o_slot_c]))
+    ow = ow.at[fr_s, o_slot_c].set(
+        jnp.where(e_valid, jnp.where(o_exists, ow[fr_s, o_slot_c] + ew, ew),
+                  ow[fr_s, o_slot_c]))
+    ocnt = ocnt.at[fr_s].set(jnp.where(e_valid & ~o_exists, ocnt[fr_s] + 1, ocnt[fr_s]))
+
+    icnt = jnp.pad(g.in_cnt, (0, 1))
+    iids = jnp.pad(g.in_ids, ((0, 1), (0, 0)))
+    iw = jnp.pad(g.in_w, ((0, 1), (0, 0)))
+    im = (jnp.arange(E)[None, :] < icnt[to_s][:, None]) & (iids[to_s] == fr_s[:, None])
+    i_exists = check & jnp.any(im, axis=1) & e_valid
+    i_slot = jnp.where(i_exists, jnp.argmax(im, axis=1), icnt[to_s]).astype(jnp.int32)
+    edge_cap = edge_cap | jnp.any(e_valid & (i_slot >= E))
+    i_slot_c = jnp.clip(i_slot, 0, E - 1)
+    iids = iids.at[to_s, i_slot_c].set(jnp.where(e_valid, fr_s, iids[to_s, i_slot_c]))
+    iw = iw.at[to_s, i_slot_c].set(
+        jnp.where(e_valid, jnp.where(i_exists, iw[to_s, i_slot_c] + ew, ew),
+                  iw[to_s, i_slot_c]))
+    icnt = icnt.at[to_s].set(jnp.where(e_valid & ~i_exists, icnt[to_s] + 1, icnt[to_s]))
+
+    n_read = jnp.pad(g.n_read, (0, 1)).at[fr_s].add(
+        jnp.where(e_valid, 1, 0))[:-1]
+
+    # ---- aligned-group registration for mismatch-new nodes ------------------
+    # each op's group is distinct (collision excluded) -> parallel scatters
+    mmn_node = jnp.where(mm_new, node, N)                       # (T,)
+    mmn_newid = jnp.where(mm_new, new_id, N)
+    acnt = jnp.pad(g.aligned_cnt, (0, 1))
+    aids = jnp.pad(g.aligned, ((0, 1), (0, 0)))
+    # existing members gain the new node; the new node gains all members + node
+    memb_ok = (jnp.arange(A)[None, :] < acnt[mmn_node][:, None]) & mm_new[:, None]
+    memb = jnp.where(memb_ok, grp_ids, N)                       # (T, A)
+    grp_full = jnp.any(mm_new & (acnt[mmn_node] + 1 > A)) | \
+        jnp.any(memb_ok & (acnt[jnp.clip(memb, 0, N)] + 1 > A))
+    # member rows: append new_id at slot acnt[member]
+    m_slot = jnp.clip(acnt[jnp.clip(memb, 0, N)], 0, A - 1)
+    aids = aids.at[jnp.clip(memb, 0, N + 0), m_slot].set(
+        jnp.where(memb_ok, mmn_newid[:, None], aids[jnp.clip(memb, 0, N), m_slot]))
+    acnt = acnt.at[jnp.clip(memb, 0, N)].add(jnp.where(memb_ok, 1, 0))
+    # node row: append new_id
+    n_slot = jnp.clip(acnt[mmn_node], 0, A - 1)
+    aids = aids.at[mmn_node, n_slot].set(
+        jnp.where(mm_new, mmn_newid, aids[mmn_node, n_slot]))
+    acnt = acnt.at[mmn_node].add(jnp.where(mm_new, 1, 0))
+    # new row: all members then node
+    k_a = jnp.arange(A)[None, :]
+    new_row = jnp.where(k_a < acnt[mmn_node][:, None] - 1, memb,
+                        jnp.where(k_a == acnt[mmn_node][:, None] - 1,
+                                  mmn_node[:, None], 0))
+    aids = aids.at[mmn_newid].set(
+        jnp.where(mm_new[:, None], new_row, aids[mmn_newid]))
+    acnt = acnt.at[mmn_newid].set(
+        jnp.where(mm_new, acnt[mmn_node], acnt[mmn_newid]))
+
+    node_n = g.node_n + n_new
+    g2 = g._replace(
+        base=base, n_span=n_span, n_read=n_read,
+        in_ids=iids[:-1], in_w=iw[:-1], in_cnt=icnt[:-1],
+        out_ids=oids[:-1], out_w=ow[:-1], out_cnt=ocnt[:-1],
+        aligned=aids[:-1], aligned_cnt=acnt[:-1],
+        node_n=node_n, ok=g.ok & (node_n <= N))
+    return g2, path_nodes, L, path_new, collision, edge_cap, grp_full
+
+
+def _splice_order(order, n2i, old_n, new_n, path_nodes, path_len, path_new):
+    """Insert a read's new nodes into the topo order right after their path
+    predecessor. Valid because backtrack paths walk strictly increasing topo
+    positions; cross-group reuse edges are validated by the caller."""
+    N = order.shape[0]
+    T1 = path_nodes.shape[0]
+    r = jnp.arange(T1, dtype=jnp.int32)
+    on_path = r < path_len
+    is_new = on_path & (path_new == 1)
+    is_old = on_path & (path_new == 0)
+
+    # old position of nearest old path node before each rank (SRC for none)
+    last_old_rank = jnp.maximum.accumulate(jnp.where(is_old, r, -1))
+    anchor_node = jnp.where(last_old_rank >= 0,
+                            path_nodes[jnp.clip(last_old_rank, 0, T1 - 1)],
+                            C.SRC_NODE_ID)
+    anchor_pos = n2i[anchor_node]                                 # (T1,)
+
+    # per-gap new-node counts -> position shifts for old nodes
+    counts = jnp.zeros(N + 1, jnp.int32).at[
+        jnp.where(is_new, anchor_pos, N)].add(1)
+    shift = jnp.cumsum(counts[:N])          # shift[p] = #new at gaps <= p
+    shift_excl = shift - counts[:N]         # #new at gaps < p
+    # old nodes at position p move past all new nodes of earlier gaps; their
+    # own gap's new nodes come directly after them
+    pos = jnp.arange(N, dtype=jnp.int32)
+    old_active = pos < old_n
+    new_pos_old = pos + shift_excl
+    order2 = jnp.zeros(N + 1, jnp.int32).at[
+        jnp.where(old_active, new_pos_old, N)].set(
+        jnp.where(old_active, order, 0))[:-1]
+    # rank of a new node within its gap = running count among new ranks since
+    # the last old path node
+    cum_new = jnp.cumsum(is_new.astype(jnp.int32))
+    within = cum_new - 1 - jnp.maximum.accumulate(
+        jnp.where(is_old, cum_new, 0))
+    # position of a new node = anchor's shifted position + 1 + within-gap rank
+    shift_before = jnp.where(anchor_pos > 0,
+                             shift[jnp.clip(anchor_pos - 1, 0, N - 1)], 0)
+    npos = anchor_pos + shift_before + 1 + within
+    order2 = jnp.pad(order2, (0, 1)).at[
+        jnp.where(is_new, npos, N)].set(
+        jnp.where(is_new, path_nodes, 0))[:-1]
+    active2 = pos < new_n
+    n2i2 = jnp.zeros(N + 1, jnp.int32).at[
+        jnp.where(active2, order2, N)].set(jnp.where(active2, pos, 0))[:-1]
+    return order2, n2i2
+
+
+# --------------------------------------------------------------------------- #
+# per-read body and the fused while-loop                                      #
+# --------------------------------------------------------------------------- #
+
+def _build_tables(g: DeviceGraph, order, n2i, remain):
+    """Kernel tables as pure gathers over the dense graph arrays (same
+    construction as device_pipeline.build_tables_device)."""
+    N, E = g.in_ids.shape
+    n = g.node_n
+    rows = jnp.arange(N, dtype=jnp.int32)
+    nid = order
+    base_r = g.base[nid]
+    pre_idx = n2i[g.in_ids[nid]]
+    pre_msk = jnp.arange(E)[None, :] < g.in_cnt[nid][:, None]
+    pre_msk = pre_msk & (rows[:, None] > 0) & (rows[:, None] < n)
+    out_idx = n2i[g.out_ids[nid]]
+    out_msk = jnp.arange(E)[None, :] < g.out_cnt[nid][:, None]
+    out_msk = out_msk & (rows[:, None] > 0) & (rows[:, None] < n - 1)
+    row_active = (rows > 0) & (rows < n - 1)
+    remain_rows = remain[nid]
+    mpl0 = jnp.full(N, n, jnp.int32).at[0].set(0)
+    mpr0 = jnp.zeros(N, jnp.int32)
+    src_out = out_idx[0]
+    src_m = jnp.arange(E) < g.out_cnt[nid[0]]
+    tgt = jnp.where(src_m, src_out, N - 1)
+    mpl0 = mpl0.at[tgt].set(jnp.where(src_m, 1, mpl0[tgt]))
+    mpr0 = mpr0.at[tgt].set(jnp.where(src_m, 1, mpr0[tgt]))
+    return (base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+            remain_rows, mpl0, mpr0)
+
+
+def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
+    """Seed the empty graph with the first read as a node chain
+    (abpoa_graph.c:573-593), fully vectorized."""
+    g = state.g
+    N, E = g.in_ids.shape
+    nodes = jnp.arange(N, dtype=jnp.int32)
+    # node ids 2..qlen+1 hold query bases
+    is_seq = (nodes >= 2) & (nodes < qlen + 2)
+    qi = jnp.clip(nodes - 2, 0, query.shape[0] - 1)
+    base = jnp.where(is_seq, query[qi], 0).astype(jnp.int32)
+    wv = weight[qi].astype(jnp.int32)
+    wlast = weight[jnp.clip(qlen - 1, 0, weight.shape[0] - 1)].astype(jnp.int32)
+
+    in_ids = jnp.zeros((N, E), jnp.int32)
+    in_w = jnp.zeros((N, E), jnp.int32)
+    out_ids = jnp.zeros((N, E), jnp.int32)
+    out_w = jnp.zeros((N, E), jnp.int32)
+    # chain: SRC -> 2 -> 3 ... -> qlen+1 -> SINK
+    first = jnp.int32(2)
+    last = qlen + 1
+    in_ids = in_ids.at[:, 0].set(jnp.where(is_seq, jnp.where(nodes == first, C.SRC_NODE_ID, nodes - 1), 0))
+    in_w = in_w.at[:, 0].set(jnp.where(is_seq, wv, 0))
+    out_ids = out_ids.at[:, 0].set(jnp.where(is_seq, jnp.where(nodes == last, C.SINK_NODE_ID, nodes + 1), 0))
+    out_w = out_w.at[:, 0].set(jnp.where(
+        is_seq, jnp.where(nodes == last, wlast,
+                          weight[jnp.clip(qi + 1, 0, weight.shape[0] - 1)].astype(jnp.int32)), 0))
+    # SRC/SINK rows
+    in_ids = in_ids.at[C.SINK_NODE_ID, 0].set(last)
+    in_w = in_w.at[C.SINK_NODE_ID, 0].set(wlast)
+    out_ids = out_ids.at[C.SRC_NODE_ID, 0].set(first)
+    out_w = out_w.at[C.SRC_NODE_ID, 0].set(weight[0].astype(jnp.int32))
+    in_cnt = jnp.where(is_seq | (nodes == C.SINK_NODE_ID), 1, 0).astype(jnp.int32)
+    out_cnt = jnp.where(is_seq | (nodes == C.SRC_NODE_ID), 1, 0).astype(jnp.int32)
+    n_read = out_cnt  # one edge-add per source node (abpoa_graph.c add_edge)
+    n_span = jnp.where(is_seq | (nodes < 2), 1, 0).astype(jnp.int32)
+
+    node_n = qlen + 2
+    ok = g.ok & (node_n <= N)
+    g2 = DeviceGraph(base=base, in_ids=in_ids, in_w=in_w, in_cnt=in_cnt,
+                     out_ids=out_ids, out_w=out_w, out_cnt=out_cnt,
+                     aligned=jnp.zeros((N, g.aligned.shape[1]), jnp.int32),
+                     aligned_cnt=jnp.zeros(N, jnp.int32),
+                     n_read=n_read, n_span=n_span,
+                     node_n=node_n.astype(jnp.int32), ok=ok)
+    # topo order: SRC, 2, 3, ..., qlen+1, SINK
+    pos = jnp.arange(N, dtype=jnp.int32)
+    order = jnp.where(pos == 0, C.SRC_NODE_ID,
+                      jnp.where(pos < node_n - 1, pos + 1,
+                                jnp.where(pos == node_n - 1, C.SINK_NODE_ID, 0)))
+    order = order.astype(jnp.int32)
+    active = pos < node_n
+    n2i = jnp.zeros(N + 1, jnp.int32).at[
+        jnp.where(active, order, N)].set(jnp.where(active, pos, 0))[:-1]
+    # remain along the chain: remain[v] = node_n - 2 - position(v)
+    # (src qlen+1 ... last seq node 0, sink -1), no override needed
+    remain_by_node = jnp.where(jnp.arange(N) < node_n,
+                               node_n - 2 - n2i, 0).astype(jnp.int32)
+    # seed read path = the chain nodes 2..qlen+1 (for read-id replay);
+    # harmless no-op when the dummy (1, 8) buffer is in use (out-of-bounds
+    # scatters drop, and replay only runs when the real buffer was sized)
+    Pcap = state.paths.shape[1]
+    pk = jnp.arange(Pcap, dtype=jnp.int32)
+    seed_path = jnp.where(pk < qlen, pk + 2, 0)
+    paths = state.paths.at[state.read_idx].set(seed_path)
+    path_lens = state.path_lens.at[state.read_idx].set(qlen)
+    return FusedState(g=g2, order=order, n2i=n2i, remain=remain_by_node,
+                      read_idx=state.read_idx + 1, err=state.err,
+                      kahn_runs=state.kahn_runs, paths=paths,
+                      path_lens=path_lens, collisions=state.collisions)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
+    "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths"))
+def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
+                    qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
+                    o1, e1, oe1, o2, e2, oe2,
+                    gap_mode: int, W: int, max_ops: int,
+                    gap_on_right: bool, put_gap_at_end: bool,
+                    plane16: bool = False, max_mat: int = 0,
+                    int16_limit: int = 0, use_pallas: bool = False,
+                    pl_interpret: bool = False,
+                    record_paths: bool = False) -> FusedState:
+    """The single-dispatch progressive loop: while reads remain and no
+    capacity/error exit, align + fuse the next read entirely on device."""
+    N, E = state.g.in_ids.shape
+    Qp = seqs_pad.shape[1]
+
+    def cond(st: FusedState):
+        return (st.read_idx < n_reads) & (st.err == ERR_OK) & st.g.ok
+
+    def body(st: FusedState) -> FusedState:
+        k = st.read_idx
+        qlen = lens[k]
+        query = seqs_pad[k]
+        weight = wgts_pad[k]
+
+        def seed(st):
+            return _seed_state(st, query, qlen, weight)
+
+        def align_and_fuse(st: FusedState) -> FusedState:
+            g, order, n2i, remain = st.g, st.order, st.n2i, st.remain
+            n = g.node_n
+            # capacity pre-check: a read can add at most qlen+1 nodes
+            over_cap = n + qlen + 1 > N
+            if plane16:
+                # score-width promotion bound: traced twin of
+                # oracle.max_score_bound — once the graph (or query) outgrows
+                # the int16 budget, exit so the host re-enters with int32
+                ln = jnp.maximum(qlen, n)
+                max_score = jnp.maximum(qlen * max_mat, ln * e1 + o1)
+                need_promote = max_score > int16_limit
+            else:
+                need_promote = jnp.bool_(False)
+
+            (base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+             remain_rows, mpl0, mpr0) = _build_tables(g, order, n2i, remain)
+
+            w = w_scalar_b + jnp.int32(w_scalar_f * qlen)
+            remain_end = remain[C.SINK_NODE_ID]
+            r0 = qlen - (remain_rows[0] - remain_end - 1)
+            dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w)
+            qp = qp_mat[k]          # (m, Qp) profile of read k
+
+            def dp_scan_path(_):
+                return _dp_banded(
+                    base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                    remain_rows, mpl0, mpr0, qp, n,
+                    qlen, w, remain_end, inf_min, dp_end0,
+                    o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
+                    plane16=plane16)
+
+            if use_pallas:
+                # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
+                # back in-jit to the XLA scan on ring/band overflow (measured
+                # rate on sim10k graphs: 0.0%, PERF.md)
+                from .pallas_fused import pallas_fused_dp
+                N_, E_ = pre_idx.shape
+                is_src_out = (mpl0 == 1) & (mpr0 == 1) & \
+                    (jnp.arange(N_) > 0)
+                base_packed = base_r | (is_src_out.astype(jnp.int32) << 8)
+                pre_cnt = jnp.sum(pre_msk.astype(jnp.int32), axis=1)
+                out_cnt_r = jnp.sum(out_msk.astype(jnp.int32), axis=1)
+                H0, E10, E20, F10, F20 = _row0_planes(
+                    W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf_min)
+                row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
+                qp_padW = jnp.pad(qp, ((0, 0), (0, W)))
+                sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1, e2, oe2,
+                                n, dp_end0] + [jnp.int32(0)] * 6)
+                (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p) = pallas_fused_dp(
+                    sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
+                    remain_rows, row0H, row0E1, row0E2, qp_padW,
+                    R=N_, W=W, P=E_, O=E_, interpret=pl_interpret)
+                # the kernel writes rows 1..: patch the source row in
+                end_p = end_p.at[0].set(dp_end0)
+                beg_p = beg_p.at[0].set(0)
+
+                def take_pl(_):
+                    zeros = jnp.zeros(N_, jnp.int32)
+                    return (Hp.at[0].set(H0), E1p.at[0].set(E10),
+                            E2p.at[0].set(E20), F1p.at[0].set(F10),
+                            F2p.at[0].set(F20), beg_p, end_p,
+                            zeros, zeros, jnp.bool_(False))
+
+                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                 overflow) = lax.cond(ok_p[0] == 1, take_pl, dp_scan_path, None)
+            else:
+                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                 overflow) = dp_scan_path(None)
+
+            # global best over the sink's predecessor rows at their band ends
+            sink_rows = pre_idx[n - 1]
+            sink_msk = pre_msk[n - 1]
+            ends = jnp.minimum(qlen, dp_end[sink_rows])
+            kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
+            vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
+                             & (ends - dp_beg[sink_rows] < W),
+                             jnp.take_along_axis(Hb[sink_rows], kidx[:, None],
+                                                 axis=1)[:, 0],
+                             inf_min)
+            kk = jnp.argmax(vals)
+            best_i = sink_rows[kk]
+            best_j = ends[kk]
+
+            ops, n_ops, fin_i, fin_j, n_aln, n_match, bt_err = _backtrack_w(
+                Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
+                base_r, query, mat, best_i, best_j,
+                e1, oe1, e2, oe2, inf_min,
+                gap_mode=gap_mode, gap_on_right=gap_on_right,
+                put_gap_at_end=put_gap_at_end, max_ops=max_ops)
+
+            # reverse into forward order (+ head/tail INS for unaligned ends)
+            tt = jnp.arange(max_ops, dtype=jnp.int32)
+            head = fin_j
+            mid = head + n_ops
+            n_fwd = mid + (qlen - best_j)
+            src = jnp.clip(n_ops - 1 - (tt - head), 0, max_ops - 1)
+            in_mid = (tt >= head) & (tt < mid)
+            fwd_op = jnp.where(in_mid, ops[src, 0], 2)
+            fwd_arg = jnp.where(in_mid,
+                                order[jnp.clip(ops[src, 1], 0, N - 1)], 0)
+            ops_cap = n_fwd > max_ops
+
+            old_n = n
+
+            g2, path_nodes, path_len, path_new, collision, edge_cap, grp_full = \
+                _fuse_vectorized(g, fwd_op, fwd_arg, n_fwd, query, qlen, weight)
+
+            def seq_fuse(_):
+                fwd = jnp.stack([jnp.where(tt < n_fwd, fwd_op, 0),
+                                 jnp.where(tt < n_fwd, fwd_arg, 0)], axis=1)
+                gs = fuse_alignment(g, fwd, n_fwd, query, qlen, weight,
+                                    C.SRC_NODE_ID, C.SINK_NODE_ID,
+                                    max_ops=max_ops)
+                return gs
+
+            g2 = lax.cond(collision, seq_fuse, lambda _: g2, None)
+            # whole-graph span update (abpoa_graph.c:559-571, inc_both_ends=1)
+            nodes_r = jnp.arange(N, dtype=jnp.int32)
+            g2 = g2._replace(n_span=jnp.where(nodes_r < g2.node_n,
+                                              g2.n_span + 1, g2.n_span))
+
+            g2 = _edge_sort(g2)
+
+            # ---- topo order: splice, validate, Kahn-repair on violation -----
+            order2, n2i2 = _splice_order(order, n2i, old_n, g2.node_n,
+                                         path_nodes, path_len, path_new)
+            # validate: every edge must go forward in the spliced order
+            src_pos = n2i2[:, None]
+            dst = jnp.clip(g2.out_ids, 0, N - 1)
+            em = (jnp.arange(E)[None, :] < g2.out_cnt[:, None]) & \
+                (nodes_r[:, None] < g2.node_n)
+            bad = jnp.any(em & (n2i2[dst] <= src_pos))
+
+            def kahn(_):
+                gk, i2nk, n2ik, remk, okk = topo_sort(g2)
+                return gk._replace(ok=gk.ok & okk), i2nk, n2ik, remk
+
+            def splice_ok(_):
+                rem = _remain_doubling(g2)
+                return g2, order2, n2i2, rem
+
+            # collision-path fusion may create nodes the splice didn't see;
+            # always Kahn-repair in that case
+            need_kahn = bad | collision
+            g3, order3, n2i3, remain3 = lax.cond(need_kahn, kahn, splice_ok, None)
+
+            err = jnp.where(need_promote, ERR_PROMOTE,
+                  jnp.where(over_cap | (g2.node_n + 2 > N), ERR_NODE_CAP,
+                  jnp.where(overflow, ERR_BAND_CAP,
+                  jnp.where(edge_cap, ERR_EDGE_CAP,
+                  jnp.where(grp_full, ERR_ALIGN_CAP,
+                  jnp.where(bt_err, ERR_BACKTRACK,
+                  jnp.where(ops_cap, ERR_OPS_CAP, ERR_OK))))))).astype(jnp.int32)
+            # capacity overflow inside the sequential fallbacks (fuse_alignment
+            # / topo_sort set only a boolean ok) has no dimension attached
+            err = jnp.where((err == ERR_OK) & ~g3.ok,
+                            jnp.int32(ERR_GRAPH_CAP), err)
+            # on any error, keep the pre-read state so the host can resume
+            keep = err != ERR_OK
+
+            def pick(a, b):
+                return jax.tree_util.tree_map(
+                    lambda x, y: jnp.where(keep, x, y), a, b)
+
+            g_out = pick(st.g, g3)
+            if record_paths:
+                Pcap = st.paths.shape[1]
+                path_slice = lax.dynamic_slice(path_nodes, (0,), (Pcap,))
+                paths = st.paths.at[st.read_idx].set(
+                    jnp.where(keep, st.paths[st.read_idx], path_slice))
+                path_lens = st.path_lens.at[st.read_idx].set(
+                    jnp.where(keep, st.path_lens[st.read_idx], path_len))
+            else:
+                paths, path_lens = st.paths, st.path_lens
+            return FusedState(
+                g=g_out,
+                order=jnp.where(keep, order, order3),
+                n2i=jnp.where(keep, n2i, n2i3),
+                remain=jnp.where(keep, remain, remain3),
+                read_idx=jnp.where(keep, st.read_idx, st.read_idx + 1),
+                err=err,
+                kahn_runs=st.kahn_runs + jnp.where(~keep & need_kahn, 1, 0),
+                paths=paths, path_lens=path_lens,
+                collisions=st.collisions + jnp.where(~keep & collision, 1, 0))
+
+        return lax.cond(st.g.node_n == 2, seed, align_and_fuse, st)
+
+    return lax.while_loop(cond, body, state)
+
+
+# --------------------------------------------------------------------------- #
+# host wrapper: capacity growth + resume + download                           #
+# --------------------------------------------------------------------------- #
+
+def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
+    """Copy device state into larger-capacity arrays (device-side, jitted)."""
+    g = state.g
+    N, E = g.in_ids.shape
+    A = g.aligned.shape[1]
+
+    def grow1(x):
+        if x.ndim == 0:
+            return x
+        pads = [(0, N2 - N)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pads)
+
+    def grow2(x):
+        return jnp.pad(x, ((0, N2 - N), (0, E2 - E)))
+
+    g2 = DeviceGraph(
+        base=grow1(g.base),
+        in_ids=grow2(g.in_ids), in_w=grow2(g.in_w), in_cnt=grow1(g.in_cnt),
+        out_ids=grow2(g.out_ids), out_w=grow2(g.out_w), out_cnt=grow1(g.out_cnt),
+        aligned=jnp.pad(g.aligned, ((0, N2 - N), (0, A2 - A))),
+        aligned_cnt=grow1(g.aligned_cnt),
+        n_read=grow1(g.n_read), n_span=grow1(g.n_span),
+        node_n=g.node_n, ok=g.ok)
+    return FusedState(
+        g=g2, order=grow1(state.order), n2i=grow1(state.n2i),
+        remain=grow1(state.remain), read_idx=state.read_idx,
+        err=jnp.int32(ERR_OK), kahn_runs=state.kahn_runs,
+        paths=state.paths, path_lens=state.path_lens,
+        collisions=state.collisions)
+
+
+def fused_eligible(abpt: Params, n_seq: int) -> bool:
+    """The fused device loop covers the reference's default progressive-POA
+    configuration; other modes use the per-alignment backends."""
+    return (abpt.align_mode == C.GLOBAL_MODE
+            and abpt.wb >= 0
+            and not abpt.inc_path_score
+            and abpt.zdrop <= 0
+            and not (abpt.use_qv and abpt.max_n_cons > 1)
+            and not abpt.amb_strand
+            and not abpt.incr_fn
+            and abpt.ret_cigar
+            and n_seq >= 2)
+
+
+def progressive_poa_fused(seqs: List[np.ndarray],
+                          weights: List[np.ndarray],
+                          abpt: Params,
+                          max_chunks: int = 24,
+                          use_pallas: bool = None):
+    """Run the fused loop over a read set; returns a host POAGraph ready for
+    consensus/output (reference abpoa_poa, src/abpoa_align.c:313-353)."""
+    n_reads = len(seqs)
+    qmax = max(len(s) for s in seqs)
+    Qp = _bucket(qmax + 2, 128)
+    w_full = abpt.wb + int(abpt.wf * qmax)
+    W = max(128, _bucket_pow2(2 * w_full + 4))
+    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    E = 8
+    A = 8
+
+    seqs_pad = np.zeros((n_reads, Qp), dtype=np.int32)
+    wgts_pad = np.ones((n_reads, Qp), dtype=np.int32)
+    lens = np.zeros(n_reads, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        seqs_pad[i, : len(s)] = s
+        wgts_pad[i, : len(s)] = weights[i]
+        lens[i] = len(s)
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+    # per-read query profiles, built once: (n_reads, m, Qp)
+    qp_all = np.zeros((n_reads, abpt.m, Qp), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        qp_all[i, :, 1: len(s) + 1] = mat[:, s]
+
+    seqs_d = jnp.asarray(seqs_pad)
+    wgts_d = jnp.asarray(wgts_pad)
+    lens_d = jnp.asarray(lens)
+    qp_d = jnp.asarray(qp_all)
+    mat_d = jnp.asarray(mat)
+
+    # int16 planes while the promotion bound allows (checked per read on
+    # device; ERR_PROMOTE flips to int32 once the graph outgrows the budget)
+    int16_limit = int16_score_limit(abpt)
+    plane16 = max_score_bound(abpt, qmax, 2) <= int16_limit
+    if use_pallas is None:
+        use_pallas = abpt.device == "pallas" and abpt.gap_mode == C.CONVEX_GAP
+    pl_interpret = jax.default_backend() != "tpu"
+
+    record_paths = bool(abpt.use_read_ids)
+    state = init_fused_state(N, E, A,
+                             n_reads=n_reads if record_paths else 1,
+                             Pcap=Qp + 2 if record_paths else 8)
+    kahn_total = 0
+    for _ in range(max_chunks):
+        max_ops = N + Qp + 8
+        inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+        state = run_fused_chunk(
+            state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
+            qp_d, mat_d, jnp.int32(abpt.wb), jnp.float32(abpt.wf),
+            jnp.int32(inf_min),
+            jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+            jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+            jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
+            gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
+            gap_on_right=bool(abpt.put_gap_on_right),
+            put_gap_at_end=bool(abpt.put_gap_at_end),
+            plane16=plane16, max_mat=int(abpt.max_mat),
+            int16_limit=int(int16_limit),
+            use_pallas=bool(use_pallas) and not plane16,
+            pl_interpret=pl_interpret, record_paths=record_paths)
+        err = int(state.err)
+        done = int(state.read_idx)
+        if err == ERR_OK and done >= n_reads:
+            break
+        if err == ERR_PROMOTE:
+            plane16 = False
+            state = state._replace(err=jnp.int32(ERR_OK))
+        elif err in (ERR_NODE_CAP, ERR_OPS_CAP):
+            N = _bucket(int(N * 1.7), 1024)
+            state = _grow_state(state, N, E, A)
+        elif err == ERR_BAND_CAP:
+            W *= 2
+            state = state._replace(err=jnp.int32(ERR_OK))
+        elif err == ERR_EDGE_CAP:
+            E *= 2
+            state = _grow_state(state, N, E, A)
+        elif err == ERR_ALIGN_CAP:
+            A *= 2
+            state = _grow_state(state, N, E, A)
+        elif err == ERR_GRAPH_CAP:
+            # the sequential fallbacks report no dimension; grow them all
+            N = _bucket(int(N * 1.7), 1024)
+            E *= 2
+            A *= 2
+            state = _grow_state(state, N, E, A)
+        elif err == ERR_BACKTRACK:
+            raise RuntimeError(
+                f"fused loop: device backtrack failed at read {done}")
+        else:
+            raise RuntimeError(f"fused loop: unknown error {err} at read {done}")
+    else:
+        raise RuntimeError("fused loop: capacity growth did not converge")
+    kahn_total = int(state.kahn_runs)
+
+    if abpt.use_read_ids and int(state.collisions) > 0:
+        # a sequential-fusion fallback may have taken a different path than
+        # the recorded one (same-group interactions); the replayed bitsets
+        # would be wrong for those reads — let the caller use the host loop
+        raise RuntimeError(
+            f"fused loop: {int(state.collisions)} sequential-fusion "
+            "fallbacks; read-id replay unavailable")
+
+    pg = _download_graph(state, abpt)
+    if abpt.use_read_ids:
+        _replay_read_ids(pg, state, n_reads)
+    return pg, kahn_total
+
+
+def _replay_read_ids(pg, state: FusedState, n_reads: int) -> None:
+    """Reconstruct per-edge read-id bitsets from the recorded fusion paths
+    (reference: abpoa_set_read_id during fusion, abpoa_graph.c:465-469).
+    Each read's path visits each node once, so its edge set is exactly the
+    consecutive pairs SRC -> p0 -> ... -> p(L-1) -> SINK. Vectorized: the
+    (edge, read) pairs accumulate into a uint64 word matrix with
+    np.bitwise_or.at, then one Python pass converts per-edge words to the
+    graph's arbitrary-precision int bitsets."""
+    paths = np.asarray(state.paths)
+    lens = np.asarray(state.path_lens)
+    n_nodes = pg.node_n
+    frs, tos, rids = [], [], []
+    for r in range(n_reads):
+        L = int(lens[r])
+        p = paths[r, :L].astype(np.int64)
+        fr = np.concatenate(([C.SRC_NODE_ID], p))
+        to = np.concatenate((p, [C.SINK_NODE_ID]))
+        frs.append(fr)
+        tos.append(to)
+        rids.append(np.full(L + 1, r, np.int64))
+    fr = np.concatenate(frs)
+    to = np.concatenate(tos)
+    rid = np.concatenate(rids)
+    keys = fr * n_nodes + to
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    n_words = (n_reads + 63) >> 6
+    words = np.zeros((len(uniq), n_words), np.uint64)
+    np.bitwise_or.at(words, (inverse, rid >> 6),
+                     np.uint64(1) << (rid & 63).astype(np.uint64))
+    for e, key in enumerate(uniq):
+        nd = pg.nodes[int(key) // n_nodes]
+        slot = nd.out_ids.index(int(key) % n_nodes)
+        nd.read_ids[slot] = int.from_bytes(words[e].tobytes(), "little")
+
+
+def _download_graph(state: FusedState, abpt: Params):
+    """One device->host transfer; rebuild the host POAGraph for output."""
+    from ..graph import POAGraph, Node
+    g = state.g
+    n = int(g.node_n)
+    base, in_ids, in_w, in_cnt, out_ids, out_w, out_cnt, aligned, aligned_cnt, \
+        n_read, n_span = [np.asarray(x) for x in (
+            g.base[:n], g.in_ids[:n], g.in_w[:n], g.in_cnt[:n],
+            g.out_ids[:n], g.out_w[:n], g.out_cnt[:n],
+            g.aligned[:n], g.aligned_cnt[:n], g.n_read[:n], g.n_span[:n])]
+    pg = POAGraph()
+    pg.nodes = []
+    for i in range(n):
+        nd = Node(i, int(base[i]))
+        ic, oc, ac = int(in_cnt[i]), int(out_cnt[i]), int(aligned_cnt[i])
+        nd.in_ids = [int(x) for x in in_ids[i][:ic]]
+        nd.in_w = [int(x) for x in in_w[i][:ic]]
+        nd.out_ids = [int(x) for x in out_ids[i][:oc]]
+        nd.out_w = [int(x) for x in out_w[i][:oc]]
+        nd.read_ids = [0] * oc
+        nd.aligned_ids = [int(x) for x in aligned[i][:ac]]
+        nd.n_read = int(n_read[i])
+        nd.n_span_read = int(n_span[i])
+        pg.nodes.append(nd)
+    pg.topological_sort(abpt)   # reference BFS order for all output walks
+    return pg

@@ -1,0 +1,649 @@
+"""JAX/TPU backend for the banded sequence-to-graph DP.
+
+TPU-first design (NOT a port of the reference's SIMD layout):
+- one `lax.scan` over topologically-ordered graph rows (the row recursion is
+  inherently sequential: each row reads its predecessor rows);
+- each row is a full-width vector over query columns, mapped onto the TPU's
+  8x128 vector lanes by XLA; band semantics are enforced by masking, so the
+  numeric results match the reference's adaptive-band kernel exactly
+  (/root/reference/src/abpoa_align_simd.c) while the compute stays static-shape;
+- the gap-open F chain is a log-step prefix-max (doubling) instead of the
+  reference's per-vector carry loop;
+- adaptive-band state (max_pos_left/right per node) lives in the scan carry and
+  is scatter-updated through padded out-edge tables — no host round trips;
+- DP planes are returned to the host for the (cheap, pointer-chasing) scalar
+  backtrack, mirroring the reference's matrix-persists-for-backtrack design.
+
+Shapes are bucketed (rows, columns, degree) to bound XLA recompilation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import constants as C
+from ..cigar import push_cigar
+from ..graph import POAGraph
+from ..params import Params
+from .oracle import _build_index_map, INT32_MIN, dp_inf_min
+from .result import AlignResult
+from .dispatch import register_backend
+
+NEG_PAD = jnp.int32(INT32_MIN // 4)
+
+
+def _bucket(n: int, step: int) -> int:
+    """Geometric bucketing (x1.3, rounded to `step`) to bound recompiles as the
+    graph grows read over read."""
+    b = step
+    while b < n:
+        b = ((int(b * 1.3) + step - 1) // step) * step
+    return b
+
+
+def _bucket_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("gap_mode", "local", "banded", "n_steps", "extend",
+                     "zdrop_on"))
+def _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+             remain_rows, mpl0, mpr0, qp,
+             qlen, w, remain_end, inf_min, dp_end0,
+             o1, e1, oe1, o2, e2, oe2,
+             gap_mode: int, local: bool, banded: bool, n_steps: int,
+             extend: bool = False, zdrop_on: bool = False,
+             pre_score=None, zdrop=0):
+    """Scan the DP over graph rows. Returns (H, E1, E2, F1, F2, dp_beg, dp_end,
+    mpl, mpr, row_max, row_left, row_right, best_score, best_i, best_j).
+
+    pre_score[(R, P)] holds the -G log-scaled path score per predecessor slot
+    (reference abpoa_graph.c:429-437); zeros when inc_path_score is off.
+    extend-mode best tracking (with optional Z-drop,
+    abpoa_align_simd.c:1076-1090) runs in the scan carry so the sequential
+    best-so-far/stop semantics match the reference exactly."""
+    R, P = pre_idx.shape
+    if pre_score is None:
+        pre_score = jnp.zeros((R, P), jnp.int32)
+    Qp = qp.shape[1]
+    cols = jnp.arange(Qp, dtype=jnp.int32)
+    inf = inf_min
+    convex = gap_mode == C.CONVEX_GAP
+    linear = gap_mode == C.LINEAR_GAP
+
+    nplanes = 1 if linear else (3 if gap_mode == C.AFFINE_GAP else 5)
+
+    # ---- first row (host passed dp_end0) -------------------------------------
+    col_valid0 = cols <= dp_end0
+    if local:
+        H0 = jnp.zeros(Qp, jnp.int32)
+        E10 = jnp.zeros(Qp, jnp.int32)
+        E20 = jnp.zeros(Qp, jnp.int32)
+        F10 = jnp.zeros(Qp, jnp.int32)
+        F20 = jnp.zeros(Qp, jnp.int32)
+    else:
+        if linear:
+            H0 = jnp.where(col_valid0, -e1 * cols, inf)
+            E10 = E20 = F10 = F20 = jnp.full(Qp, inf, jnp.int32)
+        else:
+            f1r = -o1 - e1 * cols
+            f2r = -o2 - e2 * cols
+            F10 = jnp.where(col_valid0 & (cols >= 1), f1r, inf)
+            F10 = F10.at[0].set(inf)
+            F20 = jnp.where(col_valid0 & (cols >= 1), f2r, inf) if convex \
+                else jnp.full(Qp, inf, jnp.int32)
+            F20 = F20.at[0].set(inf)
+            h0 = jnp.maximum(f1r, f2r) if convex else f1r
+            H0 = jnp.where(col_valid0 & (cols >= 1), h0, inf).at[0].set(0)
+            E10 = jnp.full(Qp, inf, jnp.int32).at[0].set(-oe1)
+            E20 = jnp.full(Qp, inf, jnp.int32).at[0].set(-oe2) if convex \
+                else jnp.full(Qp, inf, jnp.int32)
+
+    Hb = jnp.full((R, Qp), inf, jnp.int32).at[0].set(H0)
+    E1b = jnp.full((R, Qp), inf, jnp.int32).at[0].set(E10)
+    E2b = jnp.full((R, Qp), inf, jnp.int32).at[0].set(E20)
+    F1b = jnp.full((R, Qp), inf, jnp.int32).at[0].set(F10)
+    F2b = jnp.full((R, Qp), inf, jnp.int32).at[0].set(F20)
+    dp_beg = jnp.zeros(R, jnp.int32)
+    dp_end = jnp.zeros(R, jnp.int32).at[0].set(dp_end0)
+    # extra slot at index R for masked scatter targets
+    mpl = jnp.concatenate([mpl0, jnp.zeros(1, jnp.int32)])
+    mpr = jnp.concatenate([mpr0, jnp.zeros(1, jnp.int32)])
+
+    n_chain_steps = max(1, (Qp - 1).bit_length())
+
+    def chain_max(A, ext):
+        # F[j] = max_k (A[j-k] - k*ext): log-step doubling. Decayed values are
+        # floored at inf_min so long all-inf prefixes cannot wrap int32 (the
+        # reference instead relies on its 512-step inf_min margin).
+        F = A
+        shift = 1
+        for _ in range(n_chain_steps):
+            prev = jnp.concatenate([jnp.full(shift, inf, jnp.int32), F[:-shift]])
+            # floor before subtracting so inf-region cells cannot wrap int32
+            shifted = jnp.maximum(prev, inf + shift * ext) - shift * ext
+            F = jnp.maximum(F, shifted)
+            shift <<= 1
+            if shift >= Qp:
+                break
+        return F
+
+    def body(carry, i):
+        (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+         bs, bi, bj, brem, zdropped) = carry
+        active = row_active[i]
+        pm = pre_msk[i]
+        pidx = pre_idx[i]
+        ps = pre_score[i]
+
+        # ---- band ----------------------------------------------------------
+        if banded:
+            r = qlen - (remain_rows[i] - remain_end - 1)
+            beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
+            end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
+            min_pre_beg = jnp.min(jnp.where(pm, dp_beg[pidx], jnp.int32(2**30)))
+            beg = jnp.maximum(beg, min_pre_beg)
+        else:
+            beg = jnp.int32(0)
+            end = qlen
+        in_band = (cols >= beg) & (cols <= end)
+
+        # ---- M / E from predecessors --------------------------------------
+        lead = jnp.int32(0) if local else inf
+        Hpre = Hb[pidx]                      # (P, Qp)
+        shifted = jnp.concatenate(
+            [jnp.full((P, 1), lead, jnp.int32), Hpre[:, :-1]], axis=1)
+        shifted = jnp.where(pm[:, None], shifted + ps[:, None], inf)
+        Mq = jnp.max(shifted, axis=0)
+        if linear:
+            Erow = jnp.max(jnp.where(pm[:, None], Hpre - e1 + ps[:, None], inf),
+                           axis=0)
+        else:
+            Erow = jnp.max(jnp.where(pm[:, None], E1b[pidx] + ps[:, None], inf),
+                           axis=0)
+            if convex:
+                E2row = jnp.max(jnp.where(pm[:, None], E2b[pidx] + ps[:, None],
+                                          inf), axis=0)
+
+        Mq = Mq + qp[base[i]]
+        Mq = jnp.where(in_band, Mq, inf)
+        Erow = jnp.where(in_band, Erow, inf)
+        Hhat = jnp.maximum(Mq, Erow)
+        if convex:
+            E2row = jnp.where(in_band, E2row, inf)
+            Hhat = jnp.maximum(Hhat, E2row)
+
+        if linear:
+            Hrow = chain_max(Hhat, e1)
+            if local:
+                Hrow = jnp.maximum(Hrow, 0)
+            Hrow = jnp.where(in_band, Hrow, inf)
+            E1n = E2n = F1n = F2n = jnp.full(Qp, inf, jnp.int32)
+        else:
+            # F chains: F[beg] = Mq[beg]-oe; F[j] = max(Hhat[j-1]-oe, F[j-1]-e)
+            Hm1 = jnp.concatenate([jnp.full(1, inf, jnp.int32), Hhat[:-1]])
+            A1 = jnp.where(cols == beg, Mq - oe1, Hm1 - oe1)
+            A1 = jnp.where(in_band, A1, inf)
+            F1n = chain_max(A1, e1)
+            Hrow = jnp.maximum(Hhat, F1n)
+            if convex:
+                A2 = jnp.where(cols == beg, Mq - oe2, Hm1 - oe2)
+                A2 = jnp.where(in_band, A2, inf)
+                F2n = chain_max(A2, e2)
+                Hrow = jnp.maximum(Hrow, F2n)
+            else:
+                F2n = jnp.full(Qp, inf, jnp.int32)
+            if local:
+                Hrow = jnp.maximum(Hrow, 0)
+            dead = jnp.int32(0) if local else inf
+            if gap_mode == C.AFFINE_GAP:
+                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                E1n = jnp.where(Hrow == Hhat, E1n, dead)
+                E2n = jnp.full(Qp, inf, jnp.int32)
+            else:
+                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                E2n = jnp.maximum(E2row - e2, Hrow - oe2)
+                if local:
+                    E1n = jnp.maximum(E1n, 0)
+                    E2n = jnp.maximum(E2n, 0)
+            E1n = jnp.where(in_band, E1n, inf)
+            E2n = jnp.where(in_band, E2n, inf)
+            F1n = jnp.where(in_band, F1n, inf)
+            F2n = jnp.where(in_band, F2n, inf)
+            Hrow = jnp.where(in_band, Hrow, inf)
+
+        # ---- row max (adaptive band + local/extend best) ------------------
+        vals = jnp.where(in_band, Hrow, inf)
+        mx = jnp.max(vals)
+        has = mx > inf
+        eq = (vals == mx) & in_band
+        left = jnp.where(has, jnp.argmax(eq), -1).astype(jnp.int32)
+        right = jnp.where(has, Qp - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
+        if extend:
+            has_row = mx > inf
+            better = active & (~zdropped) & (mx > bs)
+            if zdrop_on:
+                delta = brem - remain_rows[i]
+                # empty-band rows (mx == -inf) Z-drop whenever any real best
+                # exists (the oracle's Python-int arithmetic, oracle.py:336);
+                # splitting the case avoids int32 wrap in bs - mx
+                zd_real = has_row & \
+                    (bs - mx > zdrop + e1 * jnp.abs(delta - (right - bj)))
+                zd = active & (~zdropped) & (~better) & \
+                    (zd_real | ((~has_row) & (bs > inf)))
+                zdropped = zdropped | zd
+            bs = jnp.where(better, mx, bs)
+            bi = jnp.where(better, i, bi)
+            bj = jnp.where(better, right, bj)
+            brem = jnp.where(better, remain_rows[i], brem)
+        if banded:
+            om = out_msk[i] & active & (~zdropped)
+            tgt = jnp.where(om, out_idx[i], R)
+            mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
+            mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
+
+        # ---- commit row (masked by active) --------------------------------
+        keep = active
+        Hb = Hb.at[i].set(jnp.where(keep, Hrow, Hb[i]))
+        if not linear:
+            E1b = E1b.at[i].set(jnp.where(keep, E1n, E1b[i]))
+            F1b = F1b.at[i].set(jnp.where(keep, F1n, F1b[i]))
+            if convex:
+                E2b = E2b.at[i].set(jnp.where(keep, E2n, E2b[i]))
+                F2b = F2b.at[i].set(jnp.where(keep, F2n, F2b[i]))
+        dp_beg = dp_beg.at[i].set(jnp.where(keep, beg, dp_beg[i]))
+        dp_end = dp_end.at[i].set(jnp.where(keep, end, dp_end[i]))
+        return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                bs, bi, bj, brem, zdropped), \
+            (jnp.where(keep, mx, inf), jnp.where(keep, left, -1),
+             jnp.where(keep, right, -1))
+
+    carry = (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+             inf, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+    carry, rows = lax.scan(body, carry, jnp.arange(1, n_steps + 1, dtype=jnp.int32))
+    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+     bs, bi, bj, _brem, _zd) = carry
+    row_max, row_left, row_right = rows
+    return (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1],
+            row_max, row_left, row_right, bs, bi, bj)
+
+
+def _build_snapshot(g: POAGraph, abpt: Params, beg_node_id: int,
+                    end_node_id: int, query: np.ndarray) -> dict:
+    """Dense kernel tables for one subgraph alignment (per-window buckets).
+
+    Mirrors the reference's per-call setup (index_map BFS
+    abpoa_align_simd.c:1259-1269, band seeding :617-626). Mutates the graph's
+    band arrays exactly like the sequential path; windows of one read touch
+    disjoint index ranges, so batched builds commute with sequential ones.
+    """
+    qlen = len(query)
+    extend = abpt.align_mode == C.EXTEND_MODE
+    zdrop_on = extend and abpt.zdrop > 0
+    banded = abpt.wb >= 0
+    w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
+    Qp = _bucket(qlen + 1, 128)
+
+    if getattr(g, "is_native", False):
+        t = g.build_tables(beg_node_id, end_node_id, banded,
+                           lambda n: _bucket(n, 64), _bucket_pow2)
+        (base, row_active_scan, pre_idx, pre_msk, out_idx, out_msk,
+         remain_rows, mpl0, mpr0) = (
+            t["base"], t["row_active"], t["pre_idx"], t["pre_msk"],
+            t["out_idx"], t["out_msk"], t["remain_rows"], t["mpl0"], t["mpr0"])
+        gn, R, beg_index, remain_end = t["gn"], t["R"], t["beg_index"], t["remain_end"]
+        pre_score = None  # native graphs are never used with -G (_want_native)
+        if banded:
+            r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
+            dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)
+        else:
+            dp_end0 = qlen
+    else:
+        beg_index = int(g.node_id_to_index[beg_node_id])
+        end_index = int(g.node_id_to_index[end_node_id])
+        gn = end_index - beg_index + 1
+        index_map = _build_index_map(g, beg_index, end_index)
+        R = _bucket(gn, 64)
+        nodes = g.nodes
+        idx2nid = g.index_to_node_id
+        base = np.zeros(R, dtype=np.int32)
+        row_active = np.zeros(R, dtype=bool)
+        max_p = 1
+        max_o = 1
+        pre_lists = []
+        slot_lists = []
+        out_lists = []
+        for i in range(gn):
+            nid = int(idx2nid[beg_index + i])
+            base[i] = nodes[nid].base
+            row_active[i] = bool(index_map[beg_index + i])
+            if i == 0 or not row_active[i]:
+                pre_lists.append([])
+                slot_lists.append([])
+                out_lists.append([])
+                continue
+            pl = []
+            slots = []
+            for k_in, p in enumerate(nodes[nid].in_ids):
+                if index_map[int(g.node_id_to_index[p])]:
+                    pl.append(int(g.node_id_to_index[p]) - beg_index)
+                    slots.append(k_in)
+            pre_lists.append(pl)
+            slot_lists.append(slots)
+            if banded and i < gn - 1:
+                ol = [int(g.node_id_to_index[o]) - beg_index for o in nodes[nid].out_ids]
+                out_lists.append(ol)
+            else:
+                out_lists.append([])
+            max_p = max(max_p, len(pl))
+            max_o = max(max_o, len(ol) if banded and i < gn - 1 else 1)
+        P = _bucket_pow2(max_p)
+        O = _bucket_pow2(max_o)
+        pre_idx = np.zeros((R, P), dtype=np.int32)
+        pre_msk = np.zeros((R, P), dtype=bool)
+        out_idx = np.zeros((R, O), dtype=np.int32)
+        out_msk = np.zeros((R, O), dtype=bool)
+        pre_score = np.zeros((R, P), dtype=np.int32) if abpt.inc_path_score else None
+        for i in range(gn):
+            pl = pre_lists[i]
+            pre_idx[i, : len(pl)] = pl
+            pre_msk[i, : len(pl)] = True
+            if pre_score is not None and pl:
+                nid = int(idx2nid[beg_index + i])
+                pre_score[i, : len(pl)] = [
+                    g.incre_path_score(nid, k_in) for k_in in slot_lists[i]]
+            ol = out_lists[i]
+            out_idx[i, : len(ol)] = ol
+            out_msk[i, : len(ol)] = True
+        # last row (end node) is computed like the reference: loop stops before it
+        row_active_scan = row_active.copy()
+        row_active_scan[gn - 1:] = False
+
+        remain_rows = np.zeros(R, dtype=np.int32)
+        mpl0 = np.zeros(R, dtype=np.int32)
+        mpr0 = np.zeros(R, dtype=np.int32)
+        remain_end = 0
+        if zdrop_on and not banded:
+            # Z-drop needs max_remain even without banding (oracle.py:126)
+            remain = g.node_id_to_max_remain
+            for i in range(gn):
+                remain_rows[i] = remain[int(idx2nid[beg_index + i])]
+            remain_end = int(remain[end_node_id])
+        if banded:
+            remain = g.node_id_to_max_remain
+            mpl_g = g.node_id_to_max_pos_left
+            mpr_g = g.node_id_to_max_pos_right
+            # first-row seeding (abpoa_align_simd.c:617-626)
+            mpl_g[beg_node_id] = mpr_g[beg_node_id] = 0
+            for out_id in nodes[beg_node_id].out_ids:
+                if index_map[int(g.node_id_to_index[out_id])]:
+                    mpl_g[out_id] = mpr_g[out_id] = 1
+            for i in range(gn):
+                nid = int(idx2nid[beg_index + i])
+                remain_rows[i] = remain[nid]
+                mpl0[i] = mpl_g[nid]
+                mpr0[i] = mpr_g[nid]
+            remain_end = int(remain[end_node_id])
+            r0 = qlen - (int(remain[beg_node_id]) - remain_end - 1)
+            dp_end0 = min(qlen, max(int(mpr_g[beg_node_id]), r0) + w)
+        else:
+            dp_end0 = qlen
+
+    mat = abpt.mat
+    qp = np.zeros((abpt.m, Qp), dtype=np.int32)
+    if qlen:
+        qp[:, 1: qlen + 1] = mat[:, query]
+
+    # sink-predecessor candidates for global best = the end row's pre slots
+    sink_rows = [int(x) for x in pre_idx[gn - 1][pre_msk[gn - 1]]]
+    if not sink_rows:
+        sink_rows = [0]
+    SR = _bucket_pow2(len(sink_rows))
+    sink_rows_a = np.zeros(SR, dtype=np.int32)
+    sink_rows_a[: len(sink_rows)] = sink_rows
+    sink_msk = np.zeros(SR, dtype=bool)
+    sink_msk[: len(sink_rows)] = True
+
+    if pre_score is None:
+        pre_score = np.zeros_like(pre_idx)
+    return dict(base=base, pre_idx=pre_idx, pre_msk=pre_msk, out_idx=out_idx,
+                out_msk=out_msk, row_active=row_active_scan,
+                remain_rows=remain_rows, mpl0=mpl0, mpr0=mpr0, qp=qp,
+                query=query.astype(np.int32), pre_score=pre_score,
+                sink_rows=sink_rows_a, sink_msk=sink_msk,
+                qlen=qlen, w=w, remain_end=remain_end, dp_end0=dp_end0,
+                gn=gn, R=R, Qp=Qp, beg_index=beg_index)
+
+
+def _pad_snapshot(s: dict, R: int, P: int, O: int, Qp: int, SR: int) -> dict:
+    """Pad one snapshot's arrays to the batch's common bucket sizes; padding
+    rows/slots are masked off, so results are unchanged."""
+    def pad(a, shape):
+        out = np.zeros(shape, dtype=a.dtype)
+        out[tuple(slice(0, d) for d in a.shape)] = a
+        return out
+    return dict(
+        base=pad(s["base"], (R,)), pre_idx=pad(s["pre_idx"], (R, P)),
+        pre_msk=pad(s["pre_msk"], (R, P)), out_idx=pad(s["out_idx"], (R, O)),
+        out_msk=pad(s["out_msk"], (R, O)),
+        row_active=pad(s["row_active"], (R,)),
+        remain_rows=pad(s["remain_rows"], (R,)),
+        mpl0=pad(s["mpl0"], (R,)), mpr0=pad(s["mpr0"], (R,)),
+        qp=pad(s["qp"], (s["qp"].shape[0], Qp)),
+        query=pad(s["query"], (Qp,)), pre_score=pad(s["pre_score"], (R, P)),
+        sink_rows=pad(s["sink_rows"], (SR,)), sink_msk=pad(s["sink_msk"], (SR,)),
+        qlen=s["qlen"], w=s["w"], remain_end=s["remain_end"],
+        dp_end0=s["dp_end0"])
+
+
+def _result_from_packed(g: POAGraph, abpt: Params, packed: np.ndarray,
+                        snap: dict, R: int, max_ops: int) -> AlignResult:
+    """Unpack one window's device output: band write-back + cigar rebuild."""
+    res = AlignResult()
+    qlen = snap["qlen"]
+    gn, beg_index = snap["gn"], snap["beg_index"]
+    idx2nid = g.index_to_node_id
+    banded = abpt.wb >= 0
+    (n_ops, fin_i, fin_j, n_aln, n_match, si, sj, err,
+     best_score, best_i, best_j) = [int(x) for x in packed[:11]]
+    off = 11
+    mpl_j = packed[off: off + R]
+    mpr_j = packed[off + R: off + 2 * R]
+    ops = packed[off + 2 * R:].reshape(max_ops, 2)
+
+    if banded:
+        if getattr(g, "is_native", False):
+            g.write_band(beg_index, gn, mpl_j[:gn], mpr_j[:gn])
+        else:
+            nids = idx2nid[beg_index: beg_index + gn]
+            g.node_id_to_max_pos_left[nids] = mpl_j[:gn]
+            g.node_id_to_max_pos_right[nids] = mpr_j[:gn]
+
+    res.best_score = best_score
+    if not abpt.ret_cigar:
+        return res
+    if err:
+        raise RuntimeError(
+            f"device backtrack failed at ({fin_i},{fin_j}) gap_mode={abpt.gap_mode}")
+    res.n_aln_bases = n_aln
+    res.n_matched_bases = n_match
+
+    # rebuild the packed cigar from the op stream (reference order: reversed)
+    cigar: list = []
+    if best_j < qlen:
+        push_cigar(cigar, C.CINS, qlen - best_j, -1, qlen - 1)
+    jj = best_j
+    for t in range(n_ops):
+        opc, dpi = int(ops[t, 0]), int(ops[t, 1])
+        nid = int(idx2nid[beg_index + dpi])
+        if opc == 0:
+            push_cigar(cigar, C.CMATCH, 1, nid, jj - 1)
+            jj -= 1
+        elif opc == 1:
+            push_cigar(cigar, C.CDEL, 1, nid, jj - 1)
+        else:
+            push_cigar(cigar, C.CINS, 1, nid, jj - 1)
+            jj -= 1
+    if fin_j > 0:
+        push_cigar(cigar, C.CINS, fin_j, -1, fin_j - 1)
+    if not abpt.rev_cigar:
+        cigar.reverse()
+    res.cigar = cigar
+    res.node_e = int(idx2nid[best_i + beg_index])
+    res.query_e = best_j - 1
+    res.node_s = int(idx2nid[si + beg_index])
+    res.query_s = sj - 1
+    return res
+
+
+_ARRAY_KEYS = ("base", "pre_idx", "pre_msk", "out_idx", "out_msk",
+               "row_active", "remain_rows", "mpl0", "mpr0", "qp", "query",
+               "pre_score", "sink_rows", "sink_msk")
+_SCALAR_KEYS = ("qlen", "w", "remain_end", "dp_end0")
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "local", "banded", "n_steps", "align_mode", "gap_on_right",
+    "put_gap_at_end", "max_ops", "ret_cigar", "zdrop_on"))
+def _dp_full_batch(arrays, scalars, inf_min, scores, zdrop, **statics):
+    """vmap of _dp_full over the window axis: all windows of one seeded read
+    are independent alignments against the same frozen graph
+    (/root/reference/src/abpoa_align.c:209-310), so one dispatch covers them."""
+    o1, e1, oe1, o2, e2, oe2 = scores
+
+    def one(arr, sc):
+        return _dp_full(
+            arr["base"], arr["pre_idx"], arr["pre_msk"], arr["out_idx"],
+            arr["out_msk"], arr["row_active"], arr["remain_rows"],
+            arr["mpl0"], arr["mpr0"], arr["qp"], arr["query"], arr["mat"],
+            arr["sink_rows"], arr["sink_msk"],
+            sc["qlen"], sc["w"], sc["remain_end"], inf_min, sc["dp_end0"],
+            o1, e1, oe1, o2, e2, oe2,
+            pre_score=arr["pre_score"], zdrop=zdrop, **statics)
+
+    return jax.vmap(one, in_axes=({k: 0 for k in list(_ARRAY_KEYS) + ["mat"]},
+                                  {k: 0 for k in _SCALAR_KEYS}))(arrays, scalars)
+
+
+def align_windows_jax(g: POAGraph, abpt: Params,
+                      windows) -> list:
+    """Align a batch of independent subgraph windows in ONE device dispatch.
+
+    windows: list of (beg_node_id, end_node_id, query) tuples. Returns one
+    AlignResult per window, byte-identical to aligning them sequentially.
+    """
+    snaps = [_build_snapshot(g, abpt, b, e, q) for b, e, q in windows]
+    R = max(s["R"] for s in snaps)
+    Qp = max(s["Qp"] for s in snaps)
+    P = max(s["pre_idx"].shape[1] for s in snaps)
+    O = max(s["out_idx"].shape[1] for s in snaps)
+    SR = max(s["sink_rows"].shape[0] for s in snaps)
+    max_ops = R + Qp + 8
+    padded = [_pad_snapshot(s, R, P, O, Qp, SR) for s in snaps]
+    # bucket the batch dim like every other dim (bounds recompiles); dummy
+    # entries duplicate the last window and their outputs are discarded
+    B = _bucket_pow2(len(padded))
+    padded.extend(padded[-1:] * (B - len(padded)))
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+    arrays = {k: jnp.asarray(np.stack([p[k] for p in padded]))
+              for k in _ARRAY_KEYS}
+    arrays["mat"] = jnp.broadcast_to(jnp.asarray(mat),
+                                     (len(padded),) + mat.shape)
+    scalars = {k: jnp.asarray(np.array([p[k] for p in padded], dtype=np.int32))
+               for k in _SCALAR_KEYS}
+    inf_min = dp_inf_min(abpt)
+    extend = abpt.align_mode == C.EXTEND_MODE
+    zdrop_on = extend and abpt.zdrop > 0
+
+    packed = _dp_full_batch(
+        arrays, scalars, jnp.int32(inf_min),
+        (jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+         jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+         jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2)),
+        jnp.int32(max(abpt.zdrop, 0)),
+        gap_mode=abpt.gap_mode, local=abpt.align_mode == C.LOCAL_MODE,
+        banded=abpt.wb >= 0, n_steps=R - 1, align_mode=abpt.align_mode,
+        gap_on_right=bool(abpt.put_gap_on_right),
+        put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
+        ret_cigar=bool(abpt.ret_cigar), zdrop_on=zdrop_on)
+    packed = np.asarray(packed)  # ONE device->host transfer for all windows
+    return [_result_from_packed(g, abpt, packed[i], snaps[i], R, max_ops)
+            for i in range(len(snaps))]
+
+
+def align_sequence_to_subgraph_jax(g: POAGraph, abpt: Params, beg_node_id: int,
+                                   end_node_id: int, query: np.ndarray) -> AlignResult:
+    return align_windows_jax(g, abpt, [(beg_node_id, end_node_id, query)])[0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "local", "banded", "n_steps", "align_mode", "gap_on_right",
+    "put_gap_at_end", "max_ops", "ret_cigar", "zdrop_on"))
+def _dp_full(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+             remain_rows, mpl0, mpr0, qp, query_pad, mat, sink_rows, sink_msk,
+             qlen, w, remain_end, inf_min, dp_end0,
+             o1, e1, oe1, o2, e2, oe2,
+             gap_mode: int, local: bool, banded: bool, n_steps: int,
+             align_mode: int, gap_on_right: bool, put_gap_at_end: bool,
+             max_ops: int, ret_cigar: bool,
+             zdrop_on: bool = False, pre_score=None, zdrop=0):
+    """DP scan + best selection + device backtrack, one packed int32 output."""
+    from .jax_backtrack import device_backtrack
+
+    extend = align_mode == C.EXTEND_MODE
+    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+     row_max, row_left, row_right, bs, bi, bj) = _dp_scan(
+        base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+        remain_rows, mpl0, mpr0, qp,
+        qlen, w, remain_end, inf_min, dp_end0,
+        o1, e1, oe1, o2, e2, oe2,
+        gap_mode=gap_mode, local=local, banded=banded, n_steps=n_steps,
+        extend=extend, zdrop_on=zdrop_on, pre_score=pre_score, zdrop=zdrop)
+
+    if align_mode == C.GLOBAL_MODE:
+        ends = jnp.minimum(qlen, dp_end[sink_rows])
+        vals = jnp.where(sink_msk, Hb[sink_rows, ends], inf_min)
+        k = jnp.argmax(vals)  # first max wins, like the strict > in the reference
+        best_score = vals[k]
+        best_i = sink_rows[k]
+        best_j = ends[k]
+    elif align_mode == C.EXTEND_MODE:
+        # best-so-far carried in the scan (required for Z-drop stop semantics)
+        best_score, best_i, best_j = bs, bi, bj
+    else:
+        k = jnp.argmax(row_max)  # first row achieving the max
+        best_score = row_max[k]
+        best_i = (k + 1).astype(jnp.int32)
+        best_j = row_left[k].astype(jnp.int32)
+
+    if ret_cigar:
+        ops, n_ops, fi, fj, n_aln, n_match, si, sj, err = device_backtrack(
+            Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
+            base, query_pad, mat, best_i, best_j,
+            e1, oe1, e2, oe2,
+            gap_mode=gap_mode, local=local, gap_on_right=gap_on_right,
+            put_gap_at_end=put_gap_at_end, max_ops=max_ops,
+            pre_score=pre_score)
+    else:
+        ops = jnp.zeros((max_ops, 2), jnp.int32)
+        n_ops = fi = fj = n_aln = n_match = si = sj = jnp.int32(0)
+        err = jnp.bool_(False)
+
+    head = jnp.stack([n_ops, fi, fj, n_aln, n_match, si, sj,
+                      err.astype(jnp.int32), best_score,
+                      best_i.astype(jnp.int32), best_j.astype(jnp.int32)])
+    return jnp.concatenate([head, mpl, mpr, ops.reshape(-1)])
+
+
+register_backend("jax", align_sequence_to_subgraph_jax)

@@ -1,0 +1,204 @@
+"""Device-side backtrack for the JAX DP backend.
+
+The reference re-reads the whole DP matrix on the host
+(/root/reference/src/abpoa_align_simd.c:309-458). Over a slow host link that
+transfer dominates, so we instead walk the traceback as a `lax.while_loop` on
+the accelerator: each iteration replays the reference's op-priority chain
+(M -> E1/E2 -> F1/F2 -> M with put_gap_on_right / put_gap_at_end switches)
+using scalar gathers into the resident DP planes, and emits one op into a
+bounded op buffer. Only that buffer (a few KB) crosses the link.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import constants as C
+
+# op codes in the emitted stream
+OP_MATCH = 0
+OP_DEL = 1
+OP_INS = 2
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "local", "gap_on_right", "put_gap_at_end", "max_ops"))
+def device_backtrack(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
+                     base, query_pad, mat, best_i, best_j,
+                     e1, oe1, e2, oe2,
+                     gap_mode: int, local: bool, gap_on_right: bool,
+                     put_gap_at_end: bool, max_ops: int, pre_score=None):
+    """Returns (ops[max_ops, 2], n_ops, final_i, final_j, n_aln, n_match,
+    start_i, start_j). ops rows: (op_code, dp_i-at-emit).
+
+    pre_score: per-(row, pred-slot) -G path score (abpoa_graph.c:429-437),
+    added to every predecessor-crossing score equality."""
+    R, P = pre_idx.shape
+    if pre_score is None:
+        pre_score = jnp.zeros((R, P), jnp.int32)
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+    i32 = jnp.int32
+
+    def gat(A, i, j):
+        return lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(A, i, 0, keepdims=False), j, 0, keepdims=False)
+
+    def state_tuple(i, j, cur_op, look_gap, n_ops, ops, n_aln, n_match,
+                    start_i, start_j, err, done):
+        return (i, j, cur_op, look_gap, n_ops, ops, n_aln, n_match,
+                start_i, start_j, err, done)
+
+    def cond(st):
+        i, j, *_, err, done = st
+        return (i > 0) & (j > 0) & (~err) & (~done)
+
+    def body(st):
+        (i, j, cur_op, look_gap, n_ops, ops, n_aln, n_match,
+         _si, _sj, err, done) = st
+        H_ij = gat(H, i, j)
+        if local:
+            stop = H_ij == 0
+        else:
+            stop = jnp.bool_(False)
+        start_i, start_j = jnp.where(stop, _si, i), jnp.where(stop, _sj, j)
+        s = mat[base[i], query_pad[j - 1]]
+        is_match = (base[i] == query_pad[j - 1]).astype(i32)
+
+        pidx = pre_idx[i]
+        pmsk = pre_msk[i]
+        ps = pre_score[i]
+        Hp_jm1 = H[pidx, j - 1]
+        Hp_j = H[pidx, j]
+        beg_p = dp_beg[pidx]
+        end_p = dp_end[pidx]
+        inb_m = (j - 1 >= beg_p) & (j - 1 <= end_p) & pmsk
+        inb_e = (j >= beg_p) & (j <= end_p) & pmsk
+
+        m_hit = inb_m & (Hp_jm1 + s + ps == H_ij)
+        any_m = jnp.any(m_hit)
+        first_m = jnp.argmax(m_hit).astype(i32)
+
+        has_M = (cur_op & C.M_OP) != 0
+
+        # ---------- eligible match (first pass) ----------
+        if linear:
+            m1_ok = (not gap_on_right) and True
+            m1 = any_m & (look_gap == 0) if m1_ok else jnp.bool_(False)
+        else:
+            m1 = any_m & has_M & (look_gap == 0) if not gap_on_right else jnp.bool_(False)
+
+        # ---------- deletion ----------
+        if linear:
+            d_hit = inb_e & (Hp_j - e1 + ps == H_ij)
+            any_d = jnp.any(d_hit)
+            first_d = jnp.argmax(d_hit).astype(i32)
+            d_new_op = jnp.int32(C.ALL_OP)
+        else:
+            E1_ij = gat(E1, i, j)
+            E1p_j = E1[pidx, j]
+            has_E1 = (cur_op & C.E1_OP) != 0
+            c1 = jnp.where(has_M, H_ij == E1p_j + ps, E1_ij == E1p_j - e1 + ps)
+            hit1 = inb_e & c1 & has_E1
+            if convex:
+                E2_ij = gat(E2, i, j)
+                E2p_j = E2[pidx, j]
+                has_E2 = (cur_op & C.E2_OP) != 0
+                c2 = jnp.where(has_M, H_ij == E2p_j + ps, E2_ij == E2p_j - e2 + ps)
+                hit2 = inb_e & c2 & has_E2
+            else:
+                hit2 = jnp.zeros_like(hit1)
+            slot_hit = hit1 | hit2
+            any_d = jnp.any(slot_hit)
+            first_d = jnp.argmax(slot_hit).astype(i32)
+            use_e1 = hit1[first_d]
+            p_d = pidx[first_d]
+            # next op set depends on whether the pre E equals pre H - oe
+            pe1 = E1p_j[first_d]
+            ph = Hp_j[first_d]
+            op_e1 = jnp.where(ph - oe1 == pe1, i32(C.M_OP | C.F_OP), i32(C.E1_OP))
+            if convex:
+                pe2 = E2p_j[first_d]
+                op_e2 = jnp.where(ph - oe2 == pe2, i32(C.M_OP | C.F_OP), i32(C.E2_OP))
+            else:
+                op_e2 = i32(C.E1_OP)
+            d_new_op = jnp.where(use_e1, op_e1, op_e2)
+
+        # ---------- insertion ----------
+        if linear:
+            H_ijm1 = gat(H, i, j - 1)
+            ins_hit = H_ijm1 - e1 == H_ij
+            ins_new_op = jnp.int32(C.ALL_OP)
+        else:
+            F1_ij = gat(F1, i, j)
+            F1_ijm1 = gat(F1, i, j - 1)
+            H_ijm1 = gat(H, i, j - 1)
+            has_F1 = (cur_op & C.F1_OP) != 0
+            f1_open = H_ijm1 - oe1 == F1_ij
+            f1_ext = F1_ijm1 - e1 == F1_ij
+            f1_gate = jnp.where(has_M, H_ij == F1_ij, True)
+            f1_hit = has_F1 & f1_gate & (f1_open | f1_ext)
+            f1_op = jnp.where(f1_open, i32(C.M_OP | C.E_OP), i32(C.F1_OP))
+            if convex:
+                F2_ij = gat(F2, i, j)
+                F2_ijm1 = gat(F2, i, j - 1)
+                has_F2 = (cur_op & C.F2_OP) != 0
+                f2_open = H_ijm1 - oe2 == F2_ij
+                f2_ext = F2_ijm1 - e2 == F2_ij
+                f2_gate = jnp.where(has_M, H_ij == F2_ij, True)
+                f2_hit = has_F2 & f2_gate & (f2_open | f2_ext)
+                f2_op = jnp.where(f2_open, i32(C.M_OP | C.E_OP), i32(C.F2_OP))
+            else:
+                f2_hit = jnp.bool_(False)
+                f2_op = i32(C.ALL_OP)
+            ins_hit = f1_hit | f2_hit
+            ins_new_op = jnp.where(f1_hit, f1_op, f2_op)
+
+        # ---------- final match ----------
+        if linear:
+            m2 = any_m
+        else:
+            m2 = any_m & has_M
+
+        # ---------- choose ----------
+        # priority: m1, D, I, m2
+        d_sel = (~m1) & any_d
+        i_sel = (~m1) & (~d_sel) & ins_hit
+        m2_sel = (~m1) & (~d_sel) & (~i_sel) & m2
+        no_hit = (~m1) & (~d_sel) & (~i_sel) & (~m2)
+        m_sel = m1 | m2_sel
+
+        op_code = jnp.where(m_sel, OP_MATCH, jnp.where(d_sel, OP_DEL, OP_INS))
+        ops = ops.at[n_ops, 0].set(jnp.where(stop | no_hit, ops[n_ops, 0], op_code))
+        ops = ops.at[n_ops, 1].set(jnp.where(stop | no_hit, ops[n_ops, 1], i))
+
+        pre_m = pidx[first_m]
+        pre_d = pidx[first_d] if not linear else pidx[first_d]
+        new_i = jnp.where(m_sel, pre_m, jnp.where(d_sel, pre_d, i))
+        new_j = jnp.where(m_sel | i_sel, j - 1, j)
+        new_op = jnp.where(m_sel, i32(C.ALL_OP),
+                           jnp.where(d_sel, d_new_op,
+                                     jnp.where(i_sel, ins_new_op, cur_op)))
+        new_look = jnp.where(m1, look_gap,
+                             jnp.where(d_sel | i_sel | m2_sel, i32(0), look_gap))
+        new_naln = n_aln + jnp.where(m_sel | i_sel, 1, 0)
+        new_nmatch = n_match + jnp.where(m_sel, is_match, 0)
+        adv = ~(stop | no_hit)
+        return state_tuple(
+            jnp.where(adv, new_i, i), jnp.where(adv, new_j, j),
+            jnp.where(adv, new_op, cur_op), jnp.where(adv, new_look, look_gap),
+            n_ops + jnp.where(adv, 1, 0), ops,
+            jnp.where(adv, new_naln, n_aln), jnp.where(adv, new_nmatch, n_match),
+            start_i, start_j, err | no_hit, done | stop)
+
+    ops0 = jnp.zeros((max_ops, 2), jnp.int32)
+    st0 = state_tuple(best_i, best_j, jnp.int32(C.ALL_OP),
+                      jnp.int32(1 if put_gap_at_end else 0), jnp.int32(0), ops0,
+                      jnp.int32(0), jnp.int32(0), best_i, best_j,
+                      jnp.bool_(False), jnp.bool_(False))
+    st = lax.while_loop(cond, body, st0)
+    (i, j, _co, _lg, n_ops, ops, n_aln, n_match, si, sj, err, _done) = st
+    return ops, n_ops, i, j, n_aln, n_match, si, sj, err

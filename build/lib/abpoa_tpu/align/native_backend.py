@@ -1,0 +1,61 @@
+"""`device=native` backend: the C++ scalar DP kernel in the host core.
+
+The fast all-host path (reference-speed, no accelerator required): graph,
+fusion, topo sort AND the banded DP + backtrack all run in C++; Python only
+orchestrates. Unsupported corners (inc_path_score) fall back to the oracle.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .. import constants as C
+from ..params import Params
+from .dispatch import register_backend
+from .result import AlignResult
+
+
+def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
+                                      end_node_id: int, query: np.ndarray) -> AlignResult:
+    if abpt.inc_path_score or not getattr(g, "is_native", False):
+        from .oracle import align_sequence_to_subgraph_numpy
+        if getattr(g, "is_native", False):
+            raise RuntimeError("native graph requires native-supported params")
+        return align_sequence_to_subgraph_numpy(g, abpt, beg_node_id, end_node_id, query)
+
+    lib = g._lib
+    qlen = len(query)
+    q = np.ascontiguousarray(query, dtype=np.uint8)
+    mat = np.ascontiguousarray(abpt.mat, dtype=np.int32)
+    params = np.array([
+        abpt.align_mode, abpt.gap_mode, abpt.wb, int(abpt.wf * 1e6),
+        abpt.zdrop, abpt.m, abpt.gap_open1, abpt.gap_ext1, abpt.gap_open2,
+        abpt.gap_ext2, abpt.min_mis, 1 if abpt.put_gap_on_right else 0,
+        1 if abpt.put_gap_at_end else 0, 1 if abpt.ret_cigar else 0,
+    ], dtype=np.int32)
+    cap = 2 * qlen + g.node_n + 16
+    cig = np.zeros(cap, dtype=np.uint64)
+    meta = np.zeros(8, dtype=np.int64)
+    rc = lib.apg_align(
+        g._h, beg_node_id, end_node_id,
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), qlen,
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        params.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cig.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), cap,
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise RuntimeError(f"native DP kernel failed (rc={rc})")
+    res = AlignResult()
+    res.best_score = int(meta[0])
+    n_c = int(meta[7])
+    res.cigar = [int(x) for x in cig[:n_c]]
+    if abpt.rev_cigar:
+        res.cigar.reverse()
+    res.node_s, res.node_e = int(meta[1]), int(meta[2])
+    res.query_s, res.query_e = int(meta[3]), int(meta[4])
+    res.n_aln_bases, res.n_matched_bases = int(meta[5]), int(meta[6])
+    return res
+
+
+register_backend("native", align_sequence_to_subgraph_native)

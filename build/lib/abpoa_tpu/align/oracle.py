@@ -1,0 +1,552 @@
+"""NumPy oracle for banded sequence-to-graph DP.
+
+This is the CPU reference backend: a faithful scalar-semantics re-derivation of
+the reference's SIMD kernel (/root/reference/src/abpoa_align_simd.c, readable
+scalar spec in /root/reference/src/abpoa_simd.c:85-622), vectorized along the
+band with NumPy. It is the correctness oracle for the TPU (JAX/Pallas) kernels
+and the default host fallback.
+
+Semantics replicated exactly (so consensus output is byte-identical):
+- adaptive band [GET_AD_DP_BEGIN, GET_AD_DP_END] (abpoa_align.h:34-35), with
+  clamp-to-min-predecessor-begin (abpoa_align_simd.c:957-959)
+- int16/int32 score-width promotion rule (abpoa_align_simd.c:1293-1302)
+- F gap chains: F[beg] = (M+q)[beg]-oe, F[j] = max(H[j-1]-oe, F[j-1]-e)
+- affine-gap conditional E kill when F dominates H (abpoa_align_simd.c:926-930)
+- backtrack op order M -> E(1,2) -> F(1,2) -> M with put_gap_on_right /
+  put_gap_at_end switches (abpoa_align_simd.c:309-458)
+- row-max left/right tie split for adaptive band propagation
+  (abpoa_align_simd.c:1107-1130)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants as C
+from ..cigar import push_cigar
+from ..graph import POAGraph
+from ..params import Params
+from .result import AlignResult
+
+INT16_MAX = 32767
+INT16_MIN = -32768
+INT32_MIN = -2147483648
+
+
+def dp_inf_min(abpt: Params, dtype_min: int = INT32_MIN) -> int:
+    """-inf clamp for DP cells: far enough below any reachable score that
+    subtraction chains cannot wrap (the 512-step margin mirrors the
+    reference's underflow headroom, abpoa_align_simd.c:1293-1302)."""
+    return (max(dtype_min + abpt.min_mis, dtype_min + abpt.gap_oe1,
+                dtype_min + abpt.gap_oe2)
+            + 512 * max(abpt.gap_ext1, abpt.gap_ext2))
+
+
+def int16_score_limit(abpt: Params) -> int:
+    """Largest worst-case score that still fits 16-bit lanes
+    (abpoa_align_simd.c:1284-1302)."""
+    return INT16_MAX - abpt.min_mis - abpt.gap_oe1 - abpt.gap_oe2
+
+
+def max_score_bound(abpt: Params, qlen: int, gn: int) -> int:
+    """Worst-case alignment score used for width selection
+    (abpoa_align_simd.c:1293-1302). The fused loop's on-device promote check
+    (fused_loop.run_fused_chunk) evaluates the same formula with traced
+    values; keep them in sync."""
+    ln = max(qlen, gn)
+    return max(qlen * abpt.max_mat, ln * abpt.gap_ext1 + abpt.gap_open1)
+
+
+def _select_dtype(abpt: Params, qlen: int, gn: int) -> Tuple[np.dtype, int]:
+    """Score width promotion (abpoa_align_simd.c:1284-1302)."""
+    max_score = max_score_bound(abpt, qlen, gn)
+    if max_score <= int16_score_limit(abpt):
+        return np.dtype(np.int16), dp_inf_min(abpt, INT16_MIN)
+    return np.dtype(np.int32), dp_inf_min(abpt, INT32_MIN)
+
+
+def _build_index_map(g: POAGraph, beg_index: int, end_index: int) -> np.ndarray:
+    """BFS-reachable subgraph mask (abpoa_align_simd.c:1259-1269)."""
+    index_map = np.zeros(g.node_n, dtype=np.uint8)
+    index_map[beg_index] = index_map[end_index] = 1
+    for i in range(beg_index, end_index - 1):
+        if not index_map[i]:
+            continue
+        node = g.nodes[int(g.index_to_node_id[i])]
+        for out_id in node.out_ids:
+            index_map[int(g.node_id_to_index[out_id])] = 1
+    return index_map
+
+
+def _prefix_max_chain(a: np.ndarray, ext: int) -> np.ndarray:
+    """F[k] = max(a[k], F[k-1]-ext): running max of a decaying chain.
+
+    Computed in int64 (the reference stays in the narrow dtype and relies on
+    its inf_min margin to avoid wrap; results agree on all non-wrapped cells).
+    """
+    n = len(a)
+    t = a.astype(np.int64) + np.arange(n, dtype=np.int64) * ext
+    np.maximum.accumulate(t, out=t)
+    return t - np.arange(n, dtype=np.int64) * ext
+
+
+class _DPState:
+    """Per-call DP planes + band bookkeeping."""
+
+    def __init__(self, rows: int, qlen: int, n_planes: int, dtype: np.dtype, inf_min: int):
+        self.qlen = qlen
+        self.inf_min = inf_min
+        self.dtype = dtype
+        shape = (rows, qlen + 1)
+        self.H = np.full(shape, inf_min, dtype=dtype)
+        self.E1 = np.full(shape, inf_min, dtype=dtype) if n_planes >= 3 else None
+        self.F1 = np.full(shape, inf_min, dtype=dtype) if n_planes >= 3 else None
+        self.E2 = np.full(shape, inf_min, dtype=dtype) if n_planes >= 5 else None
+        self.F2 = np.full(shape, inf_min, dtype=dtype) if n_planes >= 5 else None
+        self.dp_beg = np.zeros(rows, dtype=np.int32)
+        self.dp_end = np.zeros(rows, dtype=np.int32)
+
+
+def align_sequence_to_subgraph_numpy(g: POAGraph, abpt: Params, beg_node_id: int,
+                                     end_node_id: int, query: np.ndarray) -> AlignResult:
+    res = AlignResult()
+    qlen = len(query)
+    beg_index = int(g.node_id_to_index[beg_node_id])
+    end_index = int(g.node_id_to_index[end_node_id])
+    gn = end_index - beg_index + 1
+    index_map = _build_index_map(g, beg_index, end_index)
+    dtype, inf_min = _select_dtype(abpt, qlen, gn)
+
+    mat = abpt.mat
+    m = abpt.m
+    o1, e1, oe1 = abpt.gap_open1, abpt.gap_ext1, abpt.gap_oe1
+    o2, e2, oe2 = abpt.gap_open2, abpt.gap_ext2, abpt.gap_oe2
+    gap_mode = abpt.gap_mode
+    local = abpt.align_mode == C.LOCAL_MODE
+    extend = abpt.align_mode == C.EXTEND_MODE
+    w = qlen if abpt.wb < 0 else abpt.wb + int(abpt.wf * qlen)
+    banded = abpt.wb >= 0
+
+    remain = g.node_id_to_max_remain
+    mpl = g.node_id_to_max_pos_left
+    mpr = g.node_id_to_max_pos_right
+    remain_end = int(remain[end_node_id]) if (banded or abpt.zdrop > 0) else 0
+
+    def ad_beg(node_id: int) -> int:
+        r = qlen - (int(remain[node_id]) - remain_end - 1)
+        return max(0, min(int(mpl[node_id]), r) - w)
+
+    def ad_end(node_id: int) -> int:
+        r = qlen - (int(remain[node_id]) - remain_end - 1)
+        return min(qlen, max(int(mpr[node_id]), r) + w)
+
+    # query profile: qp[k][0] = 0, qp[k][j] = mat[k][query[j-1]]
+    qp = np.zeros((m, qlen + 1), dtype=dtype)
+    if qlen:
+        qp[:, 1:] = mat[:, query].astype(dtype)
+
+    # per-row predecessor dp indices, restricted to the subgraph
+    rows = gn
+    pre_index: List[List[int]] = [[] for _ in range(rows)]
+    pre_ids: List[List[int]] = [[] for _ in range(rows)]  # in-edge idx for path score
+    for index_i in range(beg_index + 1, end_index + 1):
+        dp_i = index_i - beg_index
+        node = g.nodes[int(g.index_to_node_id[index_i])]
+        for j, in_id in enumerate(node.in_ids):
+            p_idx = int(g.node_id_to_index[in_id])
+            if index_map[p_idx]:
+                pre_index[dp_i].append(p_idx - beg_index)
+                pre_ids[dp_i].append(j)
+
+    n_planes = {C.LINEAR_GAP: 1, C.AFFINE_GAP: 3, C.CONVEX_GAP: 5}[gap_mode]
+    st = _DPState(rows, qlen, n_planes, dtype, inf_min)
+    H, E1, E2, F1, F2 = st.H, st.E1, st.E2, st.F1, st.F2
+    dp_beg, dp_end = st.dp_beg, st.dp_end
+
+    # ---------------------------------------------------------- first row init
+    if banded:
+        mpl[beg_node_id] = mpr[beg_node_id] = 0
+        for out_id in g.nodes[beg_node_id].out_ids:
+            if index_map[int(g.node_id_to_index[out_id])]:
+                mpl[out_id] = mpr[out_id] = 1
+        dp_beg[0] = 0
+        dp_end[0] = ad_end(beg_node_id)
+    else:
+        dp_beg[0], dp_end[0] = 0, qlen
+    e0 = int(dp_end[0])
+    if local:
+        H[0, :] = 0
+        if E1 is not None:
+            E1[0, :] = 0
+            F1[0, :] = 0
+        if E2 is not None:
+            E2[0, :] = 0
+            F2[0, :] = 0
+    else:
+        idx = np.arange(0, e0 + 1, dtype=np.int64)
+        if gap_mode == C.LINEAR_GAP:
+            H[0, : e0 + 1] = (-e1 * idx).astype(dtype)
+        elif gap_mode == C.AFFINE_GAP:
+            H[0, 0] = 0
+            E1[0, 0] = -oe1
+            F1[0, 0] = inf_min
+            if e0 >= 1:
+                f1 = (-o1 - e1 * idx[1:]).astype(dtype)
+                F1[0, 1: e0 + 1] = f1
+                H[0, 1: e0 + 1] = f1
+        else:
+            H[0, 0] = 0
+            E1[0, 0] = -oe1
+            E2[0, 0] = -oe2
+            F1[0, 0] = F2[0, 0] = inf_min
+            if e0 >= 1:
+                f1 = (-o1 - e1 * idx[1:]).astype(dtype)
+                f2 = (-o2 - e2 * idx[1:]).astype(dtype)
+                F1[0, 1: e0 + 1] = f1
+                F2[0, 1: e0 + 1] = f2
+                H[0, 1: e0 + 1] = np.maximum(f1, f2)
+
+    # --------------------------------------------------------------- row loop
+    best_score = inf_min
+    best_i = best_j = 0
+    best_id = 0
+    zdropped = False
+
+    for index_i in range(beg_index + 1, end_index):
+        if not index_map[index_i]:
+            continue
+        dp_i = index_i - beg_index
+        node_id = int(g.index_to_node_id[index_i])
+        node = g.nodes[node_id]
+        preds = pre_index[dp_i]
+        if banded:
+            beg, end = ad_beg(node_id), ad_end(node_id)
+            min_pre_beg = min(int(dp_beg[p]) for p in preds)
+            if beg < min_pre_beg:
+                beg = min_pre_beg
+        else:
+            beg, end = 0, qlen
+        dp_beg[dp_i], dp_end[dp_i] = beg, end
+
+        ps_list = [0] * len(preds)
+        if abpt.inc_path_score:
+            ps_list = [g.incre_path_score(node_id, pre_ids[dp_i][k]) for k in range(len(preds))]
+
+        # M from pre H shifted by one column; E from pre E at same column
+        lead = dtype.type(0) if local else dtype.type(inf_min)
+        p0 = preds[0]
+        shifted = np.empty(qlen + 1, dtype=dtype)
+        shifted[0] = lead
+        shifted[1:] = H[p0, :-1]
+        Mq = shifted + dtype.type(ps_list[0])
+        if gap_mode != C.LINEAR_GAP:
+            e1row = E1[p0] + dtype.type(ps_list[0])
+            e2row = (E2[p0] + dtype.type(ps_list[0])) if gap_mode == C.CONVEX_GAP else None
+        else:
+            e1row = H[p0] - dtype.type(e1) + dtype.type(ps_list[0])
+            e2row = None
+        for k in range(1, len(preds)):
+            p = preds[k]
+            ps = dtype.type(ps_list[k])
+            shifted[0] = lead
+            shifted[1:] = H[p, :-1]
+            np.maximum(Mq, shifted + ps, out=Mq)
+            if gap_mode != C.LINEAR_GAP:
+                np.maximum(e1row, E1[p] + ps, out=e1row)
+                if e2row is not None:
+                    np.maximum(e2row, E2[p] + ps, out=e2row)
+            else:
+                np.maximum(e1row, H[p] - dtype.type(e1) + ps, out=e1row)
+
+        # add query profile
+        Mq = Mq + qp[node.base]
+        if gap_mode == C.LINEAR_GAP:
+            # H/E fused in one plane for linear gaps
+            Hhat = np.maximum(Mq, e1row)
+            bHhat = Hhat[beg: end + 1].astype(np.int64)
+            # in-row chain: H[j] = max(H[j], H[j-1]-e1)
+            chain = _prefix_max_chain(bHhat, e1)
+            brow = chain.astype(dtype)
+            if local:
+                np.maximum(brow, 0, out=brow)
+            H[dp_i, :] = inf_min
+            H[dp_i, beg: end + 1] = brow
+        else:
+            Hhat = np.maximum(Mq, e1row)
+            if e2row is not None:
+                np.maximum(Hhat, e2row, out=Hhat)
+            # F chains over the band
+            bH = Hhat[beg: end + 1]
+            bMq = Mq[beg: end + 1]
+            n = end - beg + 1
+            a1 = np.empty(n, dtype=np.int64)
+            a1[0] = int(bMq[0]) - oe1
+            if n > 1:
+                a1[1:] = bH[:-1].astype(np.int64) - oe1
+            f1 = _prefix_max_chain(a1, e1).astype(dtype)
+            if e2row is not None:
+                a2 = np.empty(n, dtype=np.int64)
+                a2[0] = int(bMq[0]) - oe2
+                if n > 1:
+                    a2[1:] = bH[:-1].astype(np.int64) - oe2
+                f2 = _prefix_max_chain(a2, e2).astype(dtype)
+            # H = max(Hhat, F)
+            bfinal = np.maximum(bH, f1)
+            if e2row is not None:
+                np.maximum(bfinal, f2, out=bfinal)
+            if local:
+                np.maximum(bfinal, 0, out=bfinal)
+            # E for next row
+            if gap_mode == C.AFFINE_GAP:
+                # E' killed where F strictly dominated H (abpoa_align_simd.c:926-930)
+                be1 = np.maximum(e1row[beg: end + 1] - dtype.type(e1), bfinal - dtype.type(oe1))
+                dead = dtype.type(0) if local else dtype.type(inf_min)
+                be1 = np.where(bfinal == bH, be1, dead)
+            else:
+                be1 = np.maximum(e1row[beg: end + 1] - dtype.type(e1), bfinal - dtype.type(oe1))
+                be2 = np.maximum(e2row[beg: end + 1] - dtype.type(e2), bfinal - dtype.type(oe2))
+                if local:
+                    np.maximum(be1, 0, out=be1)
+                    np.maximum(be2, 0, out=be2)
+            H[dp_i, :] = inf_min
+            E1[dp_i, :] = inf_min
+            F1[dp_i, :] = inf_min
+            H[dp_i, beg: end + 1] = bfinal
+            E1[dp_i, beg: end + 1] = be1
+            F1[dp_i, beg: end + 1] = f1
+            if e2row is not None:
+                E2[dp_i, :] = inf_min
+                F2[dp_i, :] = inf_min
+                E2[dp_i, beg: end + 1] = be2
+                F2[dp_i, beg: end + 1] = f2
+
+        # row max for local/extend scoring and adaptive band propagation
+        if local or extend or banded:
+            brow = H[dp_i, beg: end + 1]
+            mx = int(brow.max()) if end >= beg else inf_min
+            if mx > inf_min:
+                eq = np.flatnonzero(brow == dtype.type(mx))
+                left_max_i = beg + int(eq[0])
+                right_max_i = beg + int(eq[-1])
+                row_max = mx
+            else:
+                left_max_i = right_max_i = -1
+                row_max = inf_min
+            if local:
+                if row_max > best_score:
+                    best_score, best_i, best_j = row_max, dp_i, left_max_i
+            elif extend:
+                if row_max > best_score:
+                    best_score, best_i, best_j, best_id = row_max, dp_i, right_max_i, node_id
+                elif abpt.zdrop > 0:
+                    delta = int(remain[best_id]) - int(remain[node_id])
+                    if best_score - row_max > abpt.zdrop + e1 * abs(delta - (right_max_i - best_j)):
+                        zdropped = True
+                        break
+            if banded:
+                for out_id in node.out_ids:
+                    if right_max_i + 1 > mpr[out_id]:
+                        mpr[out_id] = right_max_i + 1
+                    if left_max_i + 1 < mpl[out_id]:
+                        mpl[out_id] = left_max_i + 1
+
+    # ------------------------------------------------------------- best score
+    if abpt.align_mode == C.GLOBAL_MODE:
+        for i, in_id in enumerate(g.nodes[end_node_id].in_ids):
+            in_index = int(g.node_id_to_index[in_id])
+            if not index_map[in_index]:
+                continue
+            dp_i = in_index - beg_index
+            end = min(qlen, int(dp_end[dp_i]))
+            v = int(H[dp_i, end])
+            if v > best_score:
+                best_score, best_i, best_j = v, dp_i, end
+    res.best_score = best_score
+
+    if abpt.ret_cigar:
+        _backtrack(g, abpt, st, pre_index, pre_ids, beg_index, best_i, best_j,
+                   qlen, query, res, gap_mode, inf_min)
+    return res
+
+
+def _backtrack(g: POAGraph, abpt: Params, st: _DPState, pre_index, pre_ids,
+               beg_index: int, best_i: int, best_j: int, qlen: int,
+               query: np.ndarray, res: AlignResult, gap_mode: int, inf_min: int) -> None:
+    """Scalar backtrack, replicating the reference's op priority + tie-breaks
+    (abpoa_align_simd.c:116-458)."""
+    H, E1, E2, F1, F2 = st.H, st.E1, st.E2, st.F1, st.F2
+    dp_beg, dp_end = st.dp_beg, st.dp_end
+    mat = abpt.mat
+    m = abpt.m
+    e1, oe1 = abpt.gap_ext1, abpt.gap_oe1
+    e2, oe2 = abpt.gap_ext2, abpt.gap_oe2
+    local = abpt.align_mode == C.LOCAL_MODE
+
+    cigar: List[int] = []
+    dp_i, dp_j = best_i, best_j
+    start_i, start_j = best_i, best_j
+    node_id = int(g.index_to_node_id[dp_i + beg_index])
+    if best_j < qlen:
+        push_cigar(cigar, C.CINS, qlen - best_j, -1, qlen - 1)
+    look_gap_at_end = 1 if abpt.put_gap_at_end else 0
+    gap_on_right = 1 if abpt.put_gap_on_right else 0
+    cur_op = C.ALL_OP
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+
+    def ps_of(nid: int, k: int) -> int:
+        if abpt.inc_path_score:
+            return g.incre_path_score(nid, pre_ids[dp_i][k])
+        return 0
+
+    while dp_i > 0 and dp_j > 0:
+        if local and H[dp_i, dp_j] == 0:
+            break
+        start_i, start_j = dp_i, dp_j
+        preds = pre_index[dp_i]
+        s = int(mat[g.nodes[node_id].base, query[dp_j - 1]])
+        is_match = g.nodes[node_id].base == int(query[dp_j - 1])
+        hit = False
+
+        def try_match() -> bool:
+            nonlocal dp_i, dp_j, node_id, cur_op, look_gap_at_end
+            for k, pre_i in enumerate(preds):
+                ps = ps_of(node_id, k)
+                if dp_j - 1 < dp_beg[pre_i] or dp_j - 1 > dp_end[pre_i]:
+                    continue
+                if int(H[pre_i, dp_j - 1]) + s + ps == int(H[dp_i, dp_j]):
+                    push_cigar(cigar, C.CMATCH, 1, node_id, dp_j - 1)
+                    dp_i = pre_i
+                    dp_j -= 1
+                    node_id = int(g.index_to_node_id[dp_i + beg_index])
+                    cur_op = C.ALL_OP
+                    res.n_aln_bases += 1
+                    res.n_matched_bases += 1 if is_match else 0
+                    return True
+            return False
+
+        if gap_on_right == 0 and look_gap_at_end == 0 and (linear or cur_op & C.M_OP):
+            hit = try_match()
+            if hit and linear:
+                continue
+
+        if not hit:  # deletion
+            if linear:
+                for k, pre_i in enumerate(preds):
+                    ps = ps_of(node_id, k)
+                    if dp_j < dp_beg[pre_i] or dp_j > dp_end[pre_i]:
+                        continue
+                    if int(H[pre_i, dp_j]) - e1 + ps == int(H[dp_i, dp_j]):
+                        push_cigar(cigar, C.CDEL, 1, node_id, dp_j - 1)
+                        dp_i = pre_i
+                        node_id = int(g.index_to_node_id[dp_i + beg_index])
+                        hit = True
+                        look_gap_at_end = 0
+                        break
+            elif cur_op & C.E_OP:
+                for k, pre_i in enumerate(preds):
+                    ps = ps_of(node_id, k)
+                    if dp_j < dp_beg[pre_i] or dp_j > dp_end[pre_i]:
+                        continue
+                    done = False
+                    if cur_op & C.E1_OP:
+                        if cur_op & C.M_OP:
+                            cond = int(H[dp_i, dp_j]) == int(E1[pre_i, dp_j]) + ps
+                        else:
+                            cond = int(E1[dp_i, dp_j]) == int(E1[pre_i, dp_j]) - e1 + ps
+                        if cond:
+                            if int(H[pre_i, dp_j]) - oe1 == int(E1[pre_i, dp_j]):
+                                cur_op = C.M_OP | C.F_OP
+                            else:
+                                cur_op = C.E1_OP
+                            push_cigar(cigar, C.CDEL, 1, node_id, dp_j - 1)
+                            dp_i = pre_i
+                            node_id = int(g.index_to_node_id[dp_i + beg_index])
+                            hit = done = True
+                            look_gap_at_end = 0
+                    if not done and convex and cur_op & C.E2_OP:
+                        if cur_op & C.M_OP:
+                            cond = int(H[dp_i, dp_j]) == int(E2[pre_i, dp_j]) + ps
+                        else:
+                            cond = int(E2[dp_i, dp_j]) == int(E2[pre_i, dp_j]) - e2 + ps
+                        if cond:
+                            if int(H[pre_i, dp_j]) - oe2 == int(E2[pre_i, dp_j]):
+                                cur_op = C.M_OP | C.F_OP
+                            else:
+                                cur_op = C.E2_OP
+                            push_cigar(cigar, C.CDEL, 1, node_id, dp_j - 1)
+                            dp_i = pre_i
+                            node_id = int(g.index_to_node_id[dp_i + beg_index])
+                            hit = done = True
+                            look_gap_at_end = 0
+                    if done:
+                        break
+
+        if not hit:  # insertion
+            if linear:
+                if int(H[dp_i, dp_j - 1]) - e1 == int(H[dp_i, dp_j]):
+                    push_cigar(cigar, C.CINS, 1, node_id, dp_j - 1)
+                    dp_j -= 1
+                    look_gap_at_end = 0
+                    hit = True
+                    res.n_aln_bases += 1
+            elif cur_op & C.F_OP:
+                got = False
+                if cur_op & C.F1_OP:
+                    if cur_op & C.M_OP:
+                        if int(H[dp_i, dp_j]) == int(F1[dp_i, dp_j]):
+                            if int(H[dp_i, dp_j - 1]) - oe1 == int(F1[dp_i, dp_j]):
+                                cur_op = C.M_OP | C.E_OP
+                                got = True
+                            elif int(F1[dp_i, dp_j - 1]) - e1 == int(F1[dp_i, dp_j]):
+                                cur_op = C.F1_OP
+                                got = True
+                    else:
+                        if int(H[dp_i, dp_j - 1]) - oe1 == int(F1[dp_i, dp_j]):
+                            cur_op = C.M_OP | C.E_OP
+                            got = True
+                        elif int(F1[dp_i, dp_j - 1]) - e1 == int(F1[dp_i, dp_j]):
+                            cur_op = C.F1_OP
+                            got = True
+                if not got and convex and cur_op & C.F2_OP:
+                    if cur_op & C.M_OP:
+                        if int(H[dp_i, dp_j]) == int(F2[dp_i, dp_j]):
+                            if int(H[dp_i, dp_j - 1]) - oe2 == int(F2[dp_i, dp_j]):
+                                cur_op = C.M_OP | C.E_OP
+                                got = True
+                            elif int(F2[dp_i, dp_j - 1]) - e2 == int(F2[dp_i, dp_j]):
+                                cur_op = C.F2_OP
+                                got = True
+                    else:
+                        if int(H[dp_i, dp_j - 1]) - oe2 == int(F2[dp_i, dp_j]):
+                            cur_op = C.M_OP | C.E_OP
+                            got = True
+                        elif int(F2[dp_i, dp_j - 1]) - e2 == int(F2[dp_i, dp_j]):
+                            cur_op = C.F2_OP
+                            got = True
+                if got:
+                    push_cigar(cigar, C.CINS, 1, node_id, dp_j - 1)
+                    dp_j -= 1
+                    look_gap_at_end = 0
+                    hit = True
+                    res.n_aln_bases += 1
+
+        if not hit and (linear or cur_op & C.M_OP):
+            hit = try_match()
+            if hit:
+                look_gap_at_end = 0
+
+        if not hit:
+            raise RuntimeError(
+                f"Error in backtrack at dp_i={dp_i}, dp_j={dp_j} (gap_mode={gap_mode})")
+
+    if dp_j > 0:
+        push_cigar(cigar, C.CINS, dp_j, -1, dp_j - 1)
+    if not abpt.rev_cigar:
+        cigar.reverse()
+    res.cigar = cigar
+    res.node_e = int(g.index_to_node_id[best_i + beg_index])
+    res.query_e = best_j - 1
+    res.node_s = int(g.index_to_node_id[start_i + beg_index])
+    res.query_s = start_j - 1

@@ -1,0 +1,263 @@
+"""Pallas TPU kernel: banded adaptive-band POA DP forward pass.
+
+Where the XLA-scan backend (jax_backend._dp_scan) computes full-width rows and
+relies on masking, this kernel keeps only a fixed-width band window per row —
+the reference's actual working set — entirely on-chip:
+
+- sequential grid over topologically-ordered graph rows (later rows read
+  earlier rows' results; Pallas's in-order TPU grid guarantees ordering);
+- a VMEM ring buffer holds the last D rows' H/E1/E2 band windows (predecessor
+  fan-in on POA graphs is a short-range dependency: mismatch bubbles), so the
+  forward pass never re-reads HBM;
+- predecessor windows are realigned to the current row's band offset with a
+  padded dynamic slice (the band drifts rightward along the main diagonal);
+- the F gap chains are log-step doubling prefix-maxes over the band lanes;
+- adaptive-band state (max_pos_left/right, band begin/end) lives in SMEM
+  scratch and is updated in-kernel, matching the reference's per-row
+  propagation (abpoa_align_simd.c:1107-1130);
+- banded H/E1/E2/F1/F2 windows stream to HBM (one (1,W) block per grid step)
+  for the traceback; an `ok` flag reports band/ring overflow so the wrapper
+  can fall back to the full-width scan backend.
+
+Scope: convex-gap global banded mode (the default headline config); other
+modes/regimes run on the XLA-scan backend. Row 0 (the source row) is patched
+in by the host wrapper.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .oracle import INT32_MIN
+
+
+def _make_kernel(R, W, P, O, D, Qp):
+    def kernel(sc_ref, base_ref, pre_idx_ref, pre_cnt_ref, out_idx_ref,
+               out_cnt_ref, remain_ref, mpl0_ref, mpr0_ref, qp_ref,
+               row0H_ref, row0E1_ref, row0E2_ref,
+               H_out, E1_out, E2_out, F1_out, F2_out,
+               begend_out, mplr_out, ok_out,
+               ringH, ringE1, ringE2, dp_beg_s, dp_end_s, mpl_s, mpr_s, ok_s):
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+        qlen = sc_ref[0]
+        w = sc_ref[1]
+        remain_end = sc_ref[2]
+        inf = sc_ref[3]
+        e1, oe1 = sc_ref[5], sc_ref[6]
+        e2, oe2 = sc_ref[8], sc_ref[9]
+        gn = sc_ref[10]
+        end0 = sc_ref[11]
+
+        col = lax.broadcasted_iota(jnp.int32, (1, W), 1)
+
+        @pl.when(i == 0)
+        def _init():
+            ok_s[0] = jnp.where(end0 + 1 > W, 0, 1)
+            # seed SMEM band state from host-provided arrays
+            def seed(k, _):
+                mpl_s[k] = mpl0_ref[k]
+                mpr_s[k] = mpr0_ref[k]
+                dp_beg_s[k] = 0
+                dp_end_s[k] = 0
+                return 0
+            lax.fori_loop(0, R, seed, 0)
+            dp_beg_s[0] = 0
+            dp_end_s[0] = end0
+            ringH[0, :] = row0H_ref[0, :]
+            ringE1[0, :] = row0E1_ref[0, :]
+            ringE2[0, :] = row0E2_ref[0, :]
+
+        row = i + 1  # dp row computed by this grid step
+        active = (row < gn - 1) & (ok_s[0] == 1)
+
+        neg_row = jnp.full((1, W), inf, jnp.int32)
+
+        @pl.when(active)
+        def _row():
+            r = qlen - (remain_ref[row] - remain_end - 1)
+            beg = jnp.maximum(0, jnp.minimum(mpl_s[row], r) - w)
+            end = jnp.minimum(qlen, jnp.maximum(mpr_s[row], r) + w)
+            npre = pre_cnt_ref[row]
+
+            def mpb_body(k, acc):
+                return jnp.minimum(acc, dp_beg_s[pre_idx_ref[row, k]])
+            min_pre_beg = lax.fori_loop(0, npre, mpb_body, jnp.int32(2**30))
+            beg = jnp.maximum(beg, min_pre_beg)
+
+            # overflow checks: band wider than W, or a pred outside the ring
+            def ovf_body(k, acc):
+                return acc | (row - pre_idx_ref[row, k] >= D)
+            ovf = lax.fori_loop(0, npre, ovf_body, end - beg + 1 > W)
+
+            @pl.when(ovf)
+            def _():
+                ok_s[0] = 0
+            dp_beg_s[row] = beg
+            dp_end_s[row] = end
+
+            cols = beg + col
+            in_band = cols <= end
+
+            def gather(ring_ref, p, shift):
+                win = ring_ref[pl.ds(p % D, 1), :]
+                sh = jnp.clip(shift, -W, W)
+                padded = jnp.concatenate(
+                    [neg_row, win, neg_row], axis=1)
+                return lax.dynamic_slice(padded, (0, W + sh), (1, W))
+
+            def pred_body(k, acc):
+                Mq, E1r, E2r = acc
+                p = pre_idx_ref[row, k]
+                pbeg = dp_beg_s[p]
+                pend = dp_end_s[p]
+                hs = gather(ringH, p, beg - 1 - pbeg)
+                hs = jnp.where((cols - 1 >= pbeg) & (cols - 1 <= pend), hs, inf)
+                Mq = jnp.maximum(Mq, hs)
+                e1s = gather(ringE1, p, beg - pbeg)
+                e2s = gather(ringE2, p, beg - pbeg)
+                eok = (cols >= pbeg) & (cols <= pend)
+                E1r = jnp.maximum(E1r, jnp.where(eok, e1s, inf))
+                E2r = jnp.maximum(E2r, jnp.where(eok, e2s, inf))
+                return (Mq, E1r, E2r)
+
+            Mq, E1r, E2r = lax.fori_loop(
+                0, npre, pred_body, (neg_row, neg_row, neg_row))
+
+            qprow = qp_ref[pl.ds(base_ref[row], 1), pl.ds(beg, W)]
+            Mq = jnp.where(in_band, Mq + qprow, inf)
+            E1r = jnp.where(in_band, E1r, inf)
+            E2r = jnp.where(in_band, E2r, inf)
+            Hhat = jnp.maximum(jnp.maximum(Mq, E1r), E2r)
+
+            def chain(A, ext):
+                F = A
+                shift = 1
+                while shift < W:
+                    rolled = pltpu.roll(F, shift, axis=1)
+                    prev = jnp.where(col >= shift, rolled, inf)
+                    F = jnp.maximum(
+                        F, jnp.maximum(prev, inf + shift * ext) - shift * ext)
+                    shift <<= 1
+                return F
+
+            Hm1 = jnp.where(col >= 1, pltpu.roll(Hhat, 1, axis=1), inf)
+            A1 = jnp.where(in_band, jnp.where(col == 0, Mq - oe1, Hm1 - oe1), inf)
+            A2 = jnp.where(in_band, jnp.where(col == 0, Mq - oe2, Hm1 - oe2), inf)
+            F1 = chain(A1, e1)
+            F2 = chain(A2, e2)
+            Hrow = jnp.maximum(Hhat, jnp.maximum(F1, F2))
+            E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+            E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+            Hrow = jnp.where(in_band, Hrow, inf)
+            E1n = jnp.where(in_band, E1n, inf)
+            E2n = jnp.where(in_band, E2n, inf)
+            F1 = jnp.where(in_band, F1, inf)
+            F2 = jnp.where(in_band, F2, inf)
+
+            ringH[row % D, :] = Hrow[0]
+            ringE1[row % D, :] = E1n[0]
+            ringE2[row % D, :] = E2n[0]
+            H_out[0, :] = Hrow[0]
+            E1_out[0, :] = E1n[0]
+            E2_out[0, :] = E2n[0]
+            F1_out[0, :] = F1[0]
+            F2_out[0, :] = F2[0]
+
+            mx = jnp.max(Hrow)
+            eq = (Hrow == mx) & in_band
+            has = mx > inf
+            left = jnp.where(has, beg + jnp.argmax(eq[0]).astype(jnp.int32), -1)
+            right = jnp.where(
+                has, beg + W - 1 - jnp.argmax(eq[0, ::-1]).astype(jnp.int32), -1)
+
+            def out_body(k, _):
+                t = out_idx_ref[row, k]
+                mpr_s[t] = jnp.maximum(mpr_s[t], right + 1)
+                mpl_s[t] = jnp.minimum(mpl_s[t], left + 1)
+                return 0
+            lax.fori_loop(0, out_cnt_ref[row], out_body, 0)
+
+        @pl.when(~active)
+        def _pad():
+            H_out[0, :] = neg_row[0]
+            E1_out[0, :] = neg_row[0]
+            E2_out[0, :] = neg_row[0]
+            F1_out[0, :] = neg_row[0]
+            F2_out[0, :] = neg_row[0]
+
+        @pl.when(i == n - 1)
+        def _flush():
+            def body(k, _):
+                begend_out[k] = dp_beg_s[k]
+                begend_out[R + k] = dp_end_s[k]
+                mplr_out[k] = mpl_s[k]
+                mplr_out[R + k] = mpr_s[k]
+                return 0
+            lax.fori_loop(0, R, body, 0)
+            ok_out[0] = ok_s[0]
+
+    return kernel
+
+
+def pallas_banded_dp(scalars: np.ndarray, base, pre_idx, pre_cnt, out_idx,
+                     out_cnt, remain, mpl0, mpr0, qp_pad,
+                     row0H, row0E1, row0E2,
+                     R: int, W: int, P: int, O: int, D: int, Qp: int,
+                     interpret: bool = False):
+    """Banded forward DP. Returns (H, E1, E2, F1, F2) banded planes (R, W),
+    begend (2R,), mplr (2R,), ok (1,)."""
+    kernel = _make_kernel(R, W, P, O, D, Qp)
+    smem = lambda shape: pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                                      memory_space=pltpu.SMEM)
+    plane = pl.BlockSpec((1, W), lambda i: (i + 1, 0), memory_space=pltpu.VMEM)
+    out_shapes = (
+        [jax.ShapeDtypeStruct((R, W), jnp.int32)] * 5
+        + [jax.ShapeDtypeStruct((2 * R,), jnp.int32),
+           jax.ShapeDtypeStruct((2 * R,), jnp.int32),
+           jax.ShapeDtypeStruct((1,), jnp.int32)])
+    out_specs = [plane] * 5 + [smem((2 * R,)), smem((2 * R,)), smem((1,))]
+    in_specs = [
+        smem((16,)),            # scalars
+        smem((R,)),             # base
+        smem((R, P)),           # pre_idx
+        smem((R,)),             # pre_cnt
+        smem((R, O)),           # out_idx
+        smem((R,)),             # out_cnt
+        smem((R,)),             # remain
+        smem((R,)),             # mpl0
+        smem((R,)),             # mpr0
+        pl.BlockSpec((qp_pad.shape[0], Qp + W), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    scratch = [
+        pltpu.VMEM((D, W), jnp.int32),  # ringH
+        pltpu.VMEM((D, W), jnp.int32),  # ringE1
+        pltpu.VMEM((D, W), jnp.int32),  # ringE2
+        pltpu.SMEM((R,), jnp.int32),    # dp_beg
+        pltpu.SMEM((R,), jnp.int32),    # dp_end
+        pltpu.SMEM((R,), jnp.int32),    # mpl
+        pltpu.SMEM((R,), jnp.int32),    # mpr
+        pltpu.SMEM((1,), jnp.int32),    # ok
+    ]
+    fn = pl.pallas_call(
+        kernel,
+        grid=(R - 1,),
+        out_shape=out_shapes,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+    return fn(scalars, base, pre_idx, pre_cnt, out_idx, out_cnt, remain,
+              mpl0, mpr0, qp_pad, row0H, row0E1, row0E2)

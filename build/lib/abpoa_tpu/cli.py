@@ -1,0 +1,154 @@
+"""abpoa-compatible command-line interface (reference src/abpoa.c)."""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import __version__
+from . import constants as C
+from .params import Params
+from .pipeline import Abpoa, msa_from_file
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="abpoa-tpu",
+        description="abpoa-tpu: TPU-native adaptive banded Partial Order Alignment",
+        add_help=False,
+    )
+    p.add_argument("input", nargs="?", help="input FASTA/FASTQ (or file list with -l)")
+    p.add_argument("-m", "--aln-mode", type=int, default=C.GLOBAL_MODE)
+    p.add_argument("-M", "--match", type=int, default=C.DEFAULT_MATCH)
+    p.add_argument("-X", "--mismatch", type=int, default=C.DEFAULT_MISMATCH)
+    p.add_argument("-t", "--matrix", type=str, default=None)
+    p.add_argument("-O", "--gap-open", type=str, default=None)
+    p.add_argument("-E", "--gap-ext", type=str, default=None)
+    p.add_argument("-b", "--extra-b", type=int, default=C.EXTRA_B)
+    p.add_argument("-f", "--extra-f", type=float, default=C.EXTRA_F)
+    p.add_argument("-z", "--zdrop", type=int, default=-1)
+    p.add_argument("-e", "--bonus", type=int, default=-1)
+    p.add_argument("-G", "--inc-path-score", action="store_true")
+    p.add_argument("-L", "--sort-by-len", action="store_true")
+    p.add_argument("-R", "--gap-on-right", action="store_true")
+    p.add_argument("-J", "--gap-at-end", action="store_true")
+    p.add_argument("-Q", "--use-qual-weight", action="store_true")
+    p.add_argument("-S", "--seeding", action="store_true")
+    p.add_argument("-k", "--k-mer", type=int, default=C.DEFAULT_MMK)
+    p.add_argument("-w", "--window", type=int, default=C.DEFAULT_MMW)
+    p.add_argument("-n", "--min-poa-win", type=int, default=C.DEFAULT_MIN_POA_WIN)
+    p.add_argument("-p", "--progressive", action="store_true")
+    p.add_argument("-c", "--amino-acid", action="store_true")
+    p.add_argument("-l", "--in-list", action="store_true")
+    p.add_argument("-i", "--increment", type=str, default=None)
+    p.add_argument("-s", "--amb-strand", action="store_true")
+    p.add_argument("-o", "--output", type=str, default=None)
+    p.add_argument("-r", "--result", type=int, default=C.OUT_CONS)
+    p.add_argument("-g", "--out-pog", type=str, default=None)
+    p.add_argument("-a", "--cons-algrm", type=int, default=C.CONS_HB)
+    p.add_argument("-d", "--maxnum-cons", type=int, default=1)
+    p.add_argument("-q", "--min-freq", type=float, default=C.MULTIP_MIN_FREQ)
+    p.add_argument("-h", "--help", action="help")
+    p.add_argument("-v", "--version", action="version", version=__version__)
+    p.add_argument("-V", "--verbose", type=int, default=0)
+    p.add_argument("--device", type=str, default="numpy",
+                   help="DP backend: numpy | jax | pallas [numpy]")
+    return p
+
+
+def args_to_params(args: argparse.Namespace) -> Params:
+    abpt = Params()
+    abpt.align_mode = args.aln_mode
+    abpt.match = args.match
+    abpt.mismatch = args.mismatch
+    if args.matrix:
+        abpt.use_score_matrix = True
+        abpt.mat_fn = args.matrix
+    if args.gap_open is not None:
+        parts = args.gap_open.split(",")
+        abpt.gap_open1 = int(parts[0])
+        abpt.gap_open2 = int(parts[1]) if len(parts) > 1 else 0
+    if args.gap_ext is not None:
+        parts = args.gap_ext.split(",")
+        abpt.gap_ext1 = int(parts[0])
+        abpt.gap_ext2 = int(parts[1]) if len(parts) > 1 else 0
+    abpt.wb = args.extra_b
+    abpt.wf = args.extra_f
+    abpt.zdrop = args.zdrop
+    abpt.end_bonus = args.bonus
+    abpt.inc_path_score = args.inc_path_score
+    abpt.sort_input_seq = args.sort_by_len
+    abpt.put_gap_on_right = args.gap_on_right
+    abpt.put_gap_at_end = args.gap_at_end
+    abpt.use_qv = args.use_qual_weight
+    abpt.disable_seeding = not args.seeding
+    abpt.k = args.k_mer
+    abpt.w = args.window
+    abpt.min_w = args.min_poa_win
+    abpt.progressive_poa = args.progressive
+    if args.amino_acid:
+        abpt.m = 27
+    abpt.incr_fn = args.increment
+    abpt.amb_strand = args.amb_strand
+    r = args.result
+    if r == C.OUT_CONS:
+        abpt.out_cons, abpt.out_msa = True, False
+    elif r == C.OUT_MSA:
+        abpt.out_cons, abpt.out_msa = False, True
+    elif r == C.OUT_CONS_MSA:
+        abpt.out_cons = abpt.out_msa = True
+    elif r == C.OUT_GFA:
+        abpt.out_cons, abpt.out_gfa = False, True
+    elif r == C.OUT_CONS_GFA:
+        abpt.out_cons = abpt.out_gfa = True
+    elif r == C.OUT_CONS_FQ:
+        abpt.out_cons = abpt.out_fq = True
+    else:
+        print(f"Error: unknown output result mode: {r}.", file=sys.stderr)
+    abpt.out_pog = args.out_pog
+    abpt.cons_algrm = args.cons_algrm
+    if not 1 <= args.maxnum_cons <= 10:
+        raise SystemExit("Error: max number of consensus sequences should be 1~10.")
+    abpt.max_n_cons = args.maxnum_cons
+    abpt.min_freq = args.min_freq
+    abpt.verbose = args.verbose
+    abpt.device = args.device
+    return abpt
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.input is None:
+        build_parser().print_help(sys.stderr)
+        return 1
+    abpt = args_to_params(args).finalize()
+    from .utils import set_verbose, run_stats
+    set_verbose(abpt.verbose)
+    if abpt.verbose >= C.VERBOSE_INFO:
+        print(f"[abpoa_tpu::main] CMD: {' '.join(argv or sys.argv)}", file=sys.stderr)
+    out_fp = open(args.output, "w") if args.output and args.output != "-" else sys.stdout
+    t0 = time.time()
+    c0 = time.process_time()
+    ab = Abpoa()
+    try:
+        if args.in_list:
+            with open(args.input) as lf:
+                batch_index = 1
+                for line in lf:
+                    fn = line.strip()
+                    if not fn:
+                        continue
+                    abpt.batch_index = batch_index
+                    msa_from_file(ab, abpt, fn, out_fp)
+                    batch_index += 1
+        else:
+            msa_from_file(ab, abpt, args.input, out_fp)
+    finally:
+        if out_fp is not sys.stdout:
+            out_fp.close()
+    print(f"[abpoa_tpu::main] {run_stats(t0, c0)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

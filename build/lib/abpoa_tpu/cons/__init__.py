@@ -1,0 +1,2 @@
+from .consensus import ConsensusResult, generate_consensus
+from .msa import generate_rc_msa

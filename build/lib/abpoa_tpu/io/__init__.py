@@ -1,0 +1,1 @@
+from .fastx import read_fastx, SeqRecord

@@ -1,0 +1,62 @@
+"""FASTA/FASTQ streaming reader (gzip-transparent).
+
+Replaces the reference's kseq.h; same record model: name, comment, seq, qual.
+"""
+from __future__ import annotations
+
+import gzip
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+
+@dataclass
+class SeqRecord:
+    name: str
+    comment: str
+    seq: str
+    qual: Optional[str] = None
+    is_rc: bool = False
+
+
+def _open(path: str):
+    fp = open(path, "rb")
+    magic = fp.read(2)
+    fp.seek(0)
+    if magic == b"\x1f\x8b":
+        return gzip.open(fp, "rt")
+    return open(path, "rt")
+
+
+def iter_fastx(path: str) -> Iterator[SeqRecord]:
+    with _open(path) as fp:
+        name = comment = None
+        seq_parts: List[str] = []
+        qual_parts: List[str] = []
+        in_qual = False
+        for line in fp:
+            line = line.rstrip("\n")
+            if not line and not in_qual:
+                continue
+            if line.startswith(">") or (line.startswith("@") and not in_qual and name is None):
+                if name is not None:
+                    yield SeqRecord(name, comment or "", "".join(seq_parts), None)
+                head = line[1:].split(None, 1)
+                name = head[0] if head else ""
+                comment = head[1] if len(head) > 1 else ""
+                seq_parts, qual_parts, in_qual = [], [], False
+                is_fq = line.startswith("@")
+                if is_fq:
+                    # FASTQ: strict 4-line records
+                    seq = fp.readline().rstrip("\n")
+                    fp.readline()  # '+'
+                    qual = fp.readline().rstrip("\n")
+                    yield SeqRecord(name, comment or "", seq, qual)
+                    name = None
+            else:
+                seq_parts.append(line)
+        if name is not None:
+            yield SeqRecord(name, comment or "", "".join(seq_parts), None)
+
+
+def read_fastx(path: str) -> List[SeqRecord]:
+    return list(iter_fastx(path))

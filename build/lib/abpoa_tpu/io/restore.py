@@ -1,0 +1,166 @@
+"""Incremental-MSA graph restore: rebuild the POA DAG from an abPOA GFA or an
+MSA FASTA (with '-' gaps) so new reads can be aligned onto it.
+
+This is the framework's checkpoint/resume path (reference:
+/root/reference/src/abpoa_seq.c:385-673; CLI -i).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .. import constants as C
+from ..params import Params
+from .fastx import _open
+
+
+def _parse_gfa(ab, abpt: Params, lines: List[str]) -> None:
+    g = ab.graph
+    segs: Dict[str, str] = {}
+    seg_in_id: Dict[str, int] = {}
+    seg_out_id: Dict[str, int] = {}
+    add_read_id = abpt.use_read_ids
+    encode = abpt.char_to_code
+    p_i = -1
+    for line in lines:
+        if line.startswith("S\t"):
+            toks = line.split("\t")
+            if len(toks) < 3:
+                raise ValueError(f"bad GFA S-line: {line}")
+            if toks[1] in segs:
+                raise ValueError(f"Duplicated segment: {toks[1]}")
+            segs[toks[1]] = toks[2]
+        elif line.startswith("P\t"):
+            p_i += 1
+            p_n = p_i + 1
+            toks = line.split("\t")
+            if len(toks) < 3:
+                raise ValueError(f"bad GFA P-line: {line}")
+            path_name = toks[1]
+            items = toks[2].split(",")
+            is_rc = -1
+            last_id = C.SRC_NODE_ID
+            next_id = C.SINK_NODE_ID
+            for item in items:
+                sign = item[-1]
+                name = item[:-1]
+                if name not in segs:
+                    raise ValueError(f"segment {name} not in GFA")
+                seq = segs[name]
+                if sign == "+":
+                    if is_rc == 1:
+                        raise ValueError(f"path {path_name} mixes strands")
+                    is_rc = 0
+                    if name not in seg_in_id:
+                        in_id = out_id = -1
+                        for i, ch in enumerate(seq):
+                            nid = g.add_node(int(encode[ord(ch)]))
+                            if i == 0:
+                                in_id = nid
+                            out_id = nid
+                        seg_in_id[name] = in_id
+                        seg_out_id[name] = out_id
+                    else:
+                        in_id = seg_in_id[name]
+                        out_id = seg_out_id[name]
+                    g.add_edge(last_id, in_id, True, 1, add_read_id, False, p_i, p_n)
+                    for i in range(out_id - in_id):
+                        g.add_edge(in_id + i, in_id + i + 1, True, 1, add_read_id,
+                                   False, p_i, p_n)
+                    last_id = out_id
+                else:
+                    if is_rc == 0:
+                        raise ValueError(f"path {path_name} mixes strands")
+                    is_rc = 1
+                    if name not in seg_in_id:
+                        in_id = out_id = -1
+                        for i, ch in enumerate(seq):
+                            nid = g.add_node(int(encode[ord(ch)]))
+                            if i == 0:
+                                in_id = nid
+                            out_id = nid
+                        seg_in_id[name] = in_id
+                        seg_out_id[name] = out_id
+                    else:
+                        in_id = seg_in_id[name]
+                        out_id = seg_out_id[name]
+                    g.add_edge(out_id, next_id, True, 1, add_read_id, False, p_i, p_n)
+                    for i in range(out_id - in_id):
+                        g.add_edge(in_id + i, in_id + i + 1, True, 1, add_read_id,
+                                   False, p_i, p_n)
+                    next_id = in_id
+            if is_rc == 1:
+                g.add_edge(C.SRC_NODE_ID, next_id, True, 1, add_read_id, False, p_i, p_n)
+            else:
+                g.add_edge(last_id, C.SINK_NODE_ID, True, 1, add_read_id, False, p_i, p_n)
+            ab.names.append(path_name)
+            ab.comments.append("")
+            ab.quals.append(None)
+            ab.seqs.append("")
+            ab.is_rc.append(bool(is_rc == 1))
+
+
+def _parse_msa_fa(ab, abpt: Params, records) -> None:
+    """MSA FASTA with '-' gaps: columns map to shared nodes via rank
+    (abpoa_seq.c:572-606)."""
+    g = ab.graph
+    add_read_id = abpt.use_read_ids
+    encode = abpt.char_to_code
+    rank2node_id: List[int] = []
+    for p_i, (name, seq) in enumerate(records):
+        p_n = p_i + 1
+        if not rank2node_id:
+            rank2node_id = [0] * len(seq)
+        last_id = C.SRC_NODE_ID
+        for rank, ch in enumerate(seq):
+            if ch == "-":
+                continue
+            base = int(encode[ord(ch)])
+            cur_id = rank2node_id[rank]
+            if cur_id == 0:
+                cur_id = g.add_node(base)
+                rank2node_id[rank] = cur_id
+            elif g.node_base(cur_id) != base:
+                aln_id = g.get_aligned_id(cur_id, base)
+                if aln_id == -1:
+                    aln_id = g.add_node(base)
+                    g.add_aligned_node(cur_id, aln_id)
+                cur_id = aln_id
+            g.add_edge(last_id, cur_id, True, 1, add_read_id, False, p_i, p_n)
+            last_id = cur_id
+        g.add_edge(last_id, C.SINK_NODE_ID, True, 1, add_read_id, False, p_i, p_n)
+        ab.names.append(name)
+        ab.comments.append("")
+        ab.quals.append(None)
+        ab.seqs.append("")
+        ab.is_rc.append(False)
+
+
+def restore_graph(ab, abpt: Params) -> None:
+    """(abpoa_seq.c:608-673)"""
+    fn = abpt.incr_fn
+    if not fn:
+        return
+    with _open(fn) as fp:
+        lines = [ln.rstrip("\n") for ln in fp]
+    is_fa = any(ln.startswith(">") for ln in lines if ln)
+    if is_fa:
+        records = []
+        name = None
+        seq_parts: List[str] = []
+        for ln in lines:
+            if ln.startswith(">"):
+                if name is not None and seq_parts:
+                    records.append((name, "".join(seq_parts)))
+                name = ln[1:].split()[0] if len(ln) > 1 else ""
+                seq_parts = []
+            elif ln:
+                seq_parts.append(ln)
+        if name is not None:
+            records.append((name, "".join(seq_parts)))
+        _parse_msa_fa(ab, abpt, records)
+    else:
+        _parse_gfa(ab, abpt, lines)
+    if ab.n_seq == 0:
+        print(f"Warning: no graph/sequence restored from '{fn}'.")
+    g = ab.graph
+    g.is_called_cons = g.is_set_msa_rank = g.is_topological_sorted = False

@@ -1,0 +1,109 @@
+"""Build/load the native host core (C++ via ctypes; no pybind11 in image)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_core.cpp")
+
+
+def _host_tag() -> str:
+    """Discriminate the .so cache by host CPU: -march=native binaries must not
+    be reused on a machine with a different ISA (SIGILL otherwise)."""
+    import hashlib
+    import platform
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fp:
+            for line in fp:
+                if line.startswith(("flags", "Features")):
+                    tag += hashlib.sha1(line.encode()).hexdigest()[:8]
+                    break
+    except OSError:
+        pass
+    return tag
+
+
+_LIB = os.path.join(
+    _HERE, f"libabpoa_host_{sys.implementation.cache_tag}_{_host_tag()}.so")
+
+_lib = None
+
+
+def _build() -> None:
+    # -march=native unlocks the host's full vector width for the autovectorized
+    # DP inner loops (the library is built on demand per host, so this is safe);
+    # fall back to the portable baseline if the toolchain rejects it
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(base[:2] + ["-march=native"] + base[2:],
+                       check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        subprocess.run(base, check=True, capture_output=True)
+
+
+def load():
+    """Load (building if needed) the native library; returns None on failure."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+    except Exception:
+        return None
+
+    c = ctypes
+    i32p = c.POINTER(c.c_int32)
+    i64p = c.POINTER(c.c_int64)
+    u8p = c.POINTER(c.c_uint8)
+    u64p = c.POINTER(c.c_uint64)
+    lib.apg_create.restype = c.c_void_p
+    lib.apg_destroy.argtypes = [c.c_void_p]
+    lib.apg_reset.argtypes = [c.c_void_p]
+    lib.apg_node_n.argtypes = [c.c_void_p]
+    lib.apg_node_n.restype = c.c_int
+    lib.apg_is_sorted.argtypes = [c.c_void_p]
+    lib.apg_is_sorted.restype = c.c_int
+    lib.apg_topological_sort.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_add_node.argtypes = [c.c_void_p, c.c_int]
+    lib.apg_add_node.restype = c.c_int
+    lib.apg_add_edge.argtypes = [c.c_void_p, c.c_int, c.c_int, c.c_int,
+                                 c.c_int, c.c_int, c.c_int, c.c_int, c.c_int]
+    lib.apg_add_aligned_node.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_invalidate_sort.argtypes = [c.c_void_p]
+    lib.apg_node_base.argtypes = [c.c_void_p, c.c_int]
+    lib.apg_node_base.restype = c.c_int
+    lib.apg_get_aligned_id.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.apg_get_aligned_id.restype = c.c_int
+    lib.apg_add_alignment.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, u8p, i64p, c.c_int, u64p, c.c_int,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, i64p]
+    lib.apg_add_alignment.restype = c.c_int
+    lib.apg_build_tables.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        i32p, u8p, i32p, u8p, i32p, u8p, i32p, i32p, i32p, i32p]
+    lib.apg_build_tables.restype = c.c_int
+    lib.apg_write_band.argtypes = [c.c_void_p, c.c_int, c.c_int, i32p, i32p]
+    lib.apg_get_index.argtypes = [c.c_void_p, i32p, i32p]
+    lib.apg_get_index.restype = c.c_int
+    lib.apg_set_msa_rank.argtypes = [c.c_void_p, i32p]
+    lib.apg_set_msa_rank.restype = c.c_int
+    lib.apg_export_sizes.argtypes = [c.c_void_p, i64p]
+    lib.apg_export.argtypes = [
+        c.c_void_p, u8p, i32p, i32p, i64p, i32p, i32p, i64p, i32p, i32p,
+        i64p, i32p, i64p, i32p, i32p, i64p, u64p, i64p]
+    lib.apg_get_remain.argtypes = [c.c_void_p, i32p]
+    lib.apg_get_remain.restype = c.c_int
+    lib.apg_subgraph_nodes.argtypes = [c.c_void_p, c.c_int, c.c_int, i32p]
+    lib.apg_align.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, u8p, c.c_int, i32p, i32p,
+        u64p, c.c_int, i64p]
+    lib.apg_align.restype = c.c_int
+    _lib = lib
+    return lib

@@ -1,0 +1,271 @@
+"""Python facade over the native host core.
+
+Implements the POAGraph surface the pipeline needs; per-read fusion, topo sort
+and kernel-table building run in C++. Output-time consumers (consensus, MSA,
+GFA) get a materialized pure-Python POAGraph via `to_python()` — those run
+once per read set, so the O(V+E) export cost is irrelevant.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from .. import constants as C
+from ..params import Params
+from . import load
+
+
+def _ptr(a: np.ndarray, t):
+    return a.ctypes.data_as(ctypes.POINTER(t))
+
+
+class NativePOAGraph:
+    is_native = True
+
+    def __init__(self) -> None:
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native host core unavailable")
+        self._h = self._lib.apg_create()
+        self._version = 0
+        self._index_cache_v = -1
+        self._i2n: Optional[np.ndarray] = None
+        self._n2i: Optional[np.ndarray] = None
+
+    def __del__(self):
+        try:
+            self._lib.apg_destroy(self._h)
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- properties
+    @property
+    def node_n(self) -> int:
+        return self._lib.apg_node_n(self._h)
+
+    @property
+    def is_topological_sorted(self) -> bool:
+        return bool(self._lib.apg_is_sorted(self._h))
+
+    @is_topological_sorted.setter
+    def is_topological_sorted(self, value: bool) -> None:
+        # restore/reset paths clear this to force a re-sort; the C side
+        # already cleared it on every mutation, so only honor False
+        if value:
+            raise ValueError("cannot force-mark a native graph as sorted")
+        self._lib.apg_invalidate_sort(self._h)
+
+    def reset(self) -> None:
+        self._lib.apg_reset(self._h)
+        self._version += 1
+
+    def topological_sort(self, abpt: Params) -> None:
+        self._lib.apg_topological_sort(
+            self._h, 1 if abpt.wb >= 0 else 0, 1 if abpt.zdrop > 0 else 0)
+        self._version += 1
+
+    def _index_arrays(self):
+        if self._index_cache_v != self._version:
+            n = self.node_n
+            self._i2n = np.zeros(n, dtype=np.int32)
+            self._n2i = np.zeros(n, dtype=np.int32)
+            self._lib.apg_get_index(self._h, _ptr(self._i2n, ctypes.c_int32),
+                                    _ptr(self._n2i, ctypes.c_int32))
+            self._index_cache_v = self._version
+        return self._i2n, self._n2i
+
+    @property
+    def index_to_node_id(self) -> np.ndarray:
+        return self._index_arrays()[0]
+
+    @property
+    def node_id_to_index(self) -> np.ndarray:
+        return self._index_arrays()[1]
+
+    # ------------------------------------------------------------- mutation
+    def add_subgraph_alignment(self, abpt: Params, beg_node_id: int, end_node_id: int,
+                               seq: np.ndarray, weight: Optional[np.ndarray],
+                               qpos_to_node_id: Optional[np.ndarray],
+                               cigar: List[int], read_id: int, tot_read_n: int,
+                               inc_both_ends: bool) -> None:
+        seq = np.ascontiguousarray(seq, dtype=np.uint8)
+        seq_l = len(seq)
+        if weight is None:
+            weight = np.ones(seq_l, dtype=np.int64)
+        weight = np.ascontiguousarray(weight, dtype=np.int64)
+        cig = np.asarray(cigar, dtype=np.uint64)
+        qpos = None
+        qp_ptr = None
+        if qpos_to_node_id is not None:
+            qpos = np.ascontiguousarray(qpos_to_node_id, dtype=np.int64)
+            qp_ptr = _ptr(qpos, ctypes.c_int64)
+        add_read_weight = 1 if (abpt.use_qv and abpt.max_n_cons > 1) else 0
+        rc = self._lib.apg_add_alignment(
+            self._h, beg_node_id, end_node_id,
+            _ptr(seq, ctypes.c_uint8), _ptr(weight, ctypes.c_int64), seq_l,
+            _ptr(cig, ctypes.c_uint64) if len(cig) else None, len(cig),
+            read_id, tot_read_n,
+            1 if abpt.use_read_ids else 0, add_read_weight,
+            1 if inc_both_ends else 0,
+            1 if abpt.wb >= 0 else 0, 1 if abpt.zdrop > 0 else 0,
+            qp_ptr)
+        if rc != 0:
+            raise RuntimeError("native fusion failed")
+        if qpos_to_node_id is not None:
+            qpos_to_node_id[:seq_l] = qpos[:seq_l]
+        self._version += 1
+
+    def add_node(self, base: int) -> int:
+        """Graph-building primitive used by incremental-MSA restore
+        (io/restore.py; reference src/abpoa_seq.c:608-673)."""
+        return int(self._lib.apg_add_node(self._h, int(base)))
+
+    def add_edge(self, from_id: int, to_id: int, check_edge: bool, w: int,
+                 add_read_id: bool, add_read_weight: bool, read_id: int,
+                 tot_read_n: int) -> None:
+        self._lib.apg_add_edge(self._h, int(from_id), int(to_id),
+                               1 if check_edge else 0, int(w),
+                               1 if add_read_id else 0,
+                               1 if add_read_weight else 0, int(read_id),
+                               int(tot_read_n))
+
+    def add_aligned_node(self, node_id: int, aligned_id: int) -> None:
+        self._lib.apg_add_aligned_node(self._h, int(node_id), int(aligned_id))
+
+    def node_base(self, node_id: int) -> int:
+        return int(self._lib.apg_node_base(self._h, int(node_id)))
+
+    def get_aligned_id(self, node_id: int, base: int) -> int:
+        return int(self._lib.apg_get_aligned_id(self._h, int(node_id), int(base)))
+
+    def add_alignment(self, abpt: Params, seq, weight, qpos_to_node_id, cigar,
+                      read_id: int, tot_read_n: int, inc_both_ends: bool) -> None:
+        self.add_subgraph_alignment(abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, seq,
+                                    weight, qpos_to_node_id, cigar, read_id,
+                                    tot_read_n, inc_both_ends)
+
+    def subgraph_nodes(self, abpt: Params, inc_beg: int, inc_end: int):
+        if not self.is_topological_sorted:
+            self.topological_sort(abpt)
+        out2 = np.zeros(2, dtype=np.int32)
+        self._lib.apg_subgraph_nodes(self._h, inc_beg, inc_end,
+                                     _ptr(out2, ctypes.c_int32))
+        return int(out2[0]), int(out2[1])
+
+    # --------------------------------------------------------- kernel tables
+    def build_tables(self, beg_node_id: int, end_node_id: int, banded: bool,
+                     bucket_r, bucket_pow2):
+        """Returns dict of padded numpy tables for the JAX kernel."""
+        lib = self._lib
+        maxPO = np.zeros(5, dtype=np.int32)
+        none8 = None
+        lib.apg_build_tables(self._h, beg_node_id, end_node_id, 0, 0, 0,
+                             1 if banded else 0,
+                             none8, none8, none8, none8, none8, none8,
+                             none8, none8, none8, _ptr(maxPO, ctypes.c_int32))
+        maxP, maxO, gn, beg_index, remain_end = [int(x) for x in maxPO]
+        R = bucket_r(gn)
+        P = bucket_pow2(maxP)
+        O = bucket_pow2(maxO)
+        base = np.zeros(R, dtype=np.int32)
+        row_active = np.zeros(R, dtype=np.uint8)
+        pre_idx = np.zeros((R, P), dtype=np.int32)
+        pre_msk = np.zeros((R, P), dtype=np.uint8)
+        out_idx = np.zeros((R, O), dtype=np.int32)
+        out_msk = np.zeros((R, O), dtype=np.uint8)
+        remain_rows = np.zeros(R, dtype=np.int32)
+        mpl0 = np.zeros(R, dtype=np.int32)
+        mpr0 = np.zeros(R, dtype=np.int32)
+        lib.apg_build_tables(self._h, beg_node_id, end_node_id, R, P, O,
+                             1 if banded else 0,
+                             _ptr(base, ctypes.c_int32), _ptr(row_active, ctypes.c_uint8),
+                             _ptr(pre_idx, ctypes.c_int32), _ptr(pre_msk, ctypes.c_uint8),
+                             _ptr(out_idx, ctypes.c_int32), _ptr(out_msk, ctypes.c_uint8),
+                             _ptr(remain_rows, ctypes.c_int32),
+                             _ptr(mpl0, ctypes.c_int32), _ptr(mpr0, ctypes.c_int32),
+                             _ptr(maxPO, ctypes.c_int32))
+        row_active[gn - 1:] = 0
+        return dict(base=base, row_active=row_active.astype(bool),
+                    pre_idx=pre_idx, pre_msk=pre_msk.astype(bool),
+                    out_idx=out_idx, out_msk=out_msk.astype(bool),
+                    remain_rows=remain_rows, mpl0=mpl0, mpr0=mpr0,
+                    gn=gn, R=R, P=P, O=O, beg_index=beg_index,
+                    remain_end=remain_end)
+
+    def write_band(self, beg_index: int, gn: int, mpl: np.ndarray, mpr: np.ndarray):
+        mpl = np.ascontiguousarray(mpl, dtype=np.int32)
+        mpr = np.ascontiguousarray(mpr, dtype=np.int32)
+        self._lib.apg_write_band(self._h, beg_index, gn,
+                                 _ptr(mpl, ctypes.c_int32), _ptr(mpr, ctypes.c_int32))
+
+    # --------------------------------------------------------------- export
+    def to_python(self, abpt: Params):
+        """Materialize a pure-Python POAGraph for output-time consumers."""
+        from ..graph import POAGraph, Node
+        lib = self._lib
+        counts = np.zeros(6, dtype=np.int64)
+        lib.apg_export_sizes(self._h, _ptr(counts, ctypes.c_int64))
+        n, tin, tout, tal, trw, tbits = [int(x) for x in counts]
+        base = np.zeros(n, dtype=np.uint8)
+        n_read = np.zeros(n, dtype=np.int32)
+        n_span = np.zeros(n, dtype=np.int32)
+        in_off = np.zeros(n + 1, dtype=np.int64)
+        in_ids = np.zeros(max(tin, 1), dtype=np.int32)
+        in_w = np.zeros(max(tin, 1), dtype=np.int32)
+        out_off = np.zeros(n + 1, dtype=np.int64)
+        out_ids = np.zeros(max(tout, 1), dtype=np.int32)
+        out_w = np.zeros(max(tout, 1), dtype=np.int32)
+        al_off = np.zeros(n + 1, dtype=np.int64)
+        al_ids = np.zeros(max(tal, 1), dtype=np.int32)
+        rw_off = np.zeros(n + 1, dtype=np.int64)
+        rw_ids = np.zeros(max(trw, 1), dtype=np.int32)
+        rw_vals = np.zeros(max(trw, 1), dtype=np.int32)
+        bits = np.zeros(max(tbits, 1), dtype=np.uint64)
+        bits_off = np.zeros(max(tout, 1), dtype=np.int64)
+        bits_words = np.zeros(max(tout, 1), dtype=np.int64)
+        lib.apg_export(self._h, _ptr(base, ctypes.c_uint8),
+                       _ptr(n_read, ctypes.c_int32), _ptr(n_span, ctypes.c_int32),
+                       _ptr(in_off, ctypes.c_int64), _ptr(in_ids, ctypes.c_int32),
+                       _ptr(in_w, ctypes.c_int32),
+                       _ptr(out_off, ctypes.c_int64), _ptr(out_ids, ctypes.c_int32),
+                       _ptr(out_w, ctypes.c_int32),
+                       _ptr(al_off, ctypes.c_int64), _ptr(al_ids, ctypes.c_int32),
+                       _ptr(rw_off, ctypes.c_int64), _ptr(rw_ids, ctypes.c_int32),
+                       _ptr(rw_vals, ctypes.c_int32),
+                       _ptr(bits_off, ctypes.c_int64), _ptr(bits, ctypes.c_uint64),
+                       _ptr(bits_words, ctypes.c_int64))
+        g = POAGraph()
+        g.nodes = []
+        edge_i = 0
+        for i in range(n):
+            nd = Node(i, int(base[i]))
+            nd.in_ids = [int(x) for x in in_ids[in_off[i]: in_off[i + 1]]]
+            nd.in_w = [int(x) for x in in_w[in_off[i]: in_off[i + 1]]]
+            nd.out_ids = [int(x) for x in out_ids[out_off[i]: out_off[i + 1]]]
+            nd.out_w = [int(x) for x in out_w[out_off[i]: out_off[i + 1]]]
+            nd.aligned_ids = [int(x) for x in al_ids[al_off[i]: al_off[i + 1]]]
+            nd.n_read = int(n_read[i])
+            nd.n_span_read = int(n_span[i])
+            nd.read_weight = {int(r): int(v) for r, v in
+                              zip(rw_ids[rw_off[i]: rw_off[i + 1]],
+                                  rw_vals[rw_off[i]: rw_off[i + 1]])}
+            for _ in nd.out_ids:
+                wn = int(bits_words[edge_i])
+                off = int(bits_off[edge_i])
+                v = 0
+                for k in range(wn):
+                    v |= int(bits[off + k]) << (64 * k)
+                nd.read_ids.append(v)
+                edge_i += 1
+            g.nodes.append(nd)
+        g.is_topological_sorted = self.is_topological_sorted
+        if g.is_topological_sorted:
+            i2n, n2i = self._index_arrays()
+            g.index_to_node_id = i2n.copy()
+            g.node_id_to_index = n2i.copy()
+            remain = np.zeros(n, dtype=np.int32)
+            if self._lib.apg_get_remain(self._h, _ptr(remain, ctypes.c_int32)) == 0:
+                g.node_id_to_max_remain = remain
+        return g

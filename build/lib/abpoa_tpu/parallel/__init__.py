@@ -1,0 +1,1 @@
+from .runner import run_batch, shard_dp_batch

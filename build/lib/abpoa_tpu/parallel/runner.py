@@ -1,0 +1,79 @@
+"""Multi-device scaling: shard independent read sets across a TPU mesh.
+
+The POA algorithm needs no cross-chip collectives (SURVEY.md §2.3): the unit of
+work "align read set -> call consensus" fits one chip, so fleet scaling is data
+parallelism over read sets (the reference's `-l` file-list mode,
+/root/reference/src/abpoa.c:148-168). Two layers:
+
+- `run_batch`: round-robin read-set files over local devices; each set's DP
+  kernels are placed on its device via `jax.default_device`, host fusion stays
+  on CPU threads. No collectives ride the interconnect.
+- `shard_dp_batch`: a `shard_map`-over-Mesh batched DP step — many same-bucket
+  alignments at once, one per mesh slot. This is the building block for the
+  all-device progressive loop (PERF.md) and for multi-host DCN fan-out, where
+  each host feeds its local mesh slice.
+"""
+from __future__ import annotations
+
+from typing import IO, List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..params import Params
+
+
+def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
+              devices: List = None) -> None:
+    """Process independent read-set files, round-robin across devices."""
+    from ..pipeline import Abpoa, msa_from_file
+    devices = devices or jax.devices()
+    ab = Abpoa()
+    for i, fn in enumerate(files):
+        abpt.batch_index = i + 1
+        dev = devices[i % len(devices)]
+        with jax.default_device(dev):
+            msa_from_file(ab, abpt, fn, out_fp)
+
+
+def shard_dp_batch(mesh_devices: int = None):
+    """Build a sharded batched DP step over an n-device mesh.
+
+    Returns (mesh, step_fn) where step_fn takes per-set stacked kernel inputs
+    (leading dim = number of read sets) and runs each set's DP scan on its own
+    mesh slot. Used by __graft_entry__.dryrun_multichip and as the scaffold for
+    multi-set batch processing.
+    """
+    from jax.experimental.shard_map import shard_map
+    from ..align.jax_backend import _dp_scan
+    from .. import constants as C
+
+    devs = jax.devices()
+    n = mesh_devices or len(devs)
+    mesh = Mesh(np.array(devs[:n]), axis_names=("set",))
+
+    def one_set(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                remain_rows, mpl0, mpr0, qp, scalars):
+        (qlen, w, remain_end, inf_min, dp_end0,
+         o1, e1, oe1, o2, e2, oe2) = [scalars[i] for i in range(11)]
+        n_steps = base.shape[0] - 1
+        out = _dp_scan(base, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                       remain_rows, mpl0, mpr0, qp,
+                       qlen, w, remain_end, inf_min, dp_end0,
+                       o1, e1, oe1, o2, e2, oe2,
+                       gap_mode=C.CONVEX_GAP, local=False, banded=True,
+                       n_steps=n_steps)
+        return out[0]  # H planes
+
+    specs = tuple(P("set") for _ in range(11))
+
+    @jax.jit
+    def step(*stacked):
+        fn = shard_map(jax.vmap(one_set), mesh=mesh, in_specs=specs,
+                       out_specs=P("set"), check_rep=False)
+        return fn(*stacked)
+
+    return mesh, step

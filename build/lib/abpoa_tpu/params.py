@@ -1,0 +1,172 @@
+"""Alignment/consensus parameter object.
+
+Mirrors the reference's 3-stage parameter lifecycle (`abpoa_init_para` defaults at
+/root/reference/src/abpoa_align.c:101-158, user mutation, `abpoa_post_set_para`
+derivation at :160-185): construct `Params()`, mutate fields, call `finalize()`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from . import constants as C
+
+
+def gen_simple_mat(m: int, match: int, mismatch: int) -> np.ndarray:
+    """Match/mismatch scoring matrix (reference: src/abpoa_align.c:13-26).
+
+    Row/col m-1 is the ambiguous base ('N'): score 0 against everything.
+    """
+    match = abs(match)
+    mismatch = -abs(mismatch)
+    mat = np.full((m, m), mismatch, dtype=np.int32)
+    np.fill_diagonal(mat, match)
+    mat[:, m - 1] = 0
+    mat[m - 1, :] = 0
+    return mat
+
+
+def parse_mat_file(path: str, m: int) -> np.ndarray:
+    """Parse a scoring-matrix file (BLOSUM62-style; reference src/abpoa_align.c:35-86)."""
+    mat = np.zeros((m, m), dtype=np.int32)
+    order: list[int] = []
+    first = True
+    with open(path) as fp:
+        for line in fp:
+            if line.startswith("#"):
+                continue
+            if first:
+                first = False
+                for ch in line.split():
+                    order.append(int(C.AA26_TABLE[ord(ch[0])]))
+            else:
+                toks = line.split()
+                if not toks:
+                    continue
+                row = int(C.AA26_TABLE[ord(toks[0][0])])
+                if row >= m:
+                    raise ValueError(f"Unknown base in matrix file: {toks[0]}")
+                for n, tok in enumerate(toks[1:]):
+                    mat[row, order[n]] = int(tok)
+    return mat
+
+
+@dataclass
+class Params:
+    # alignment mode
+    align_mode: int = C.GLOBAL_MODE
+    gap_mode: int = C.CONVEX_GAP  # derived in finalize()
+    zdrop: int = -1
+    end_bonus: int = -1
+
+    inc_path_score: bool = False
+    sort_input_seq: bool = False
+    put_gap_on_right: bool = False
+    put_gap_at_end: bool = False
+
+    # adaptive band
+    wb: int = C.EXTRA_B
+    wf: float = C.EXTRA_F
+
+    amb_strand: bool = False
+    ret_cigar: bool = True
+    rev_cigar: bool = False
+    out_cons: bool = True
+    out_fq: bool = False
+    out_gfa: bool = False
+    out_msa: bool = False
+    cons_algrm: int = C.CONS_HB
+    max_n_cons: int = 1
+    sub_aln: bool = False
+    min_freq: float = C.MULTIP_MIN_FREQ
+    use_read_ids: bool = False
+    incr_fn: Optional[str] = None
+    out_pog: Optional[str] = None
+
+    # alphabet size: 5 = nucleotide, 27 = amino acid
+    m: int = 5
+
+    # scoring
+    use_score_matrix: bool = False
+    mat_fn: Optional[str] = None
+    match: int = C.DEFAULT_MATCH
+    mismatch: int = C.DEFAULT_MISMATCH
+    gap_open1: int = C.DEFAULT_GAP_OPEN1
+    gap_open2: int = C.DEFAULT_GAP_OPEN2
+    gap_ext1: int = C.DEFAULT_GAP_EXT1
+    gap_ext2: int = C.DEFAULT_GAP_EXT2
+
+    use_qv: bool = False
+    disable_seeding: bool = True
+    k: int = C.DEFAULT_MMK
+    w: int = C.DEFAULT_MMW
+    min_w: int = C.DEFAULT_MIN_POA_WIN
+    progressive_poa: bool = False
+
+    verbose: int = C.VERBOSE_NONE
+    batch_index: int = 0
+
+    # device backend for the DP kernel: "numpy" (oracle), "jax", "pallas"
+    device: str = "numpy"
+
+    # derived (set by finalize)
+    mat: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
+    max_mat: int = 0
+    min_mis: int = 0
+    _finalized: bool = field(default=False, repr=False)
+
+    def finalize(self) -> "Params":
+        """Derive gap mode / tables / matrices (reference abpoa_post_set_para)."""
+        # gap mode inference (src/abpoa_align.c:88-99)
+        if min(self.match, self.mismatch, self.gap_open1, self.gap_open2,
+               self.gap_ext1, self.gap_ext2) < 0:
+            raise ValueError("negative scoring parameters")
+        if self.gap_ext1 == 0 and self.gap_ext2 == 0:
+            raise ValueError("at least one gap extension must be positive")
+        if self.gap_open1 == 0:
+            self.gap_mode = C.LINEAR_GAP
+        elif self.gap_open2 == 0:
+            self.gap_mode = C.AFFINE_GAP
+        else:
+            self.gap_mode = C.CONVEX_GAP
+
+        if self.out_msa or self.out_gfa or self.max_n_cons > 1 or self.cons_algrm == C.CONS_MF:
+            self.use_read_ids = True
+        if self.align_mode == C.LOCAL_MODE:
+            self.wb = -1
+        if self.m > 5 and self.k > 11:  # aa sequences: smaller minimizers
+            self.k, self.w = 7, 4
+
+        if not self.use_score_matrix:
+            self.mat = gen_simple_mat(self.m, self.match, self.mismatch)
+            self.max_mat = abs(self.match)
+            self.min_mis = abs(self.mismatch)
+        else:
+            assert self.mat_fn is not None
+            self.mat = parse_mat_file(self.mat_fn, self.m)
+            self.max_mat = int(self.mat.max())
+            self.min_mis = int((-self.mat).max())
+        self._finalized = True
+        return self
+
+    @property
+    def is_aa(self) -> bool:
+        return self.m > 5
+
+    @property
+    def char_to_code(self) -> np.ndarray:
+        return C.AA26_TABLE if self.is_aa else C.NT4_TABLE
+
+    @property
+    def code_to_char(self) -> np.ndarray:
+        return C.AA256_TABLE if self.is_aa else C.NT256_TABLE
+
+    @property
+    def gap_oe1(self) -> int:
+        return self.gap_open1 + self.gap_ext1
+
+    @property
+    def gap_oe2(self) -> int:
+        return self.gap_open2 + self.gap_ext2

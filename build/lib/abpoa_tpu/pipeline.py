@@ -1,0 +1,187 @@
+"""MSA orchestration: the progressive POA loop and output fan-out.
+
+Reference: /root/reference/src/abpoa_align.c (abpoa_poa :313-353,
+abpoa_msa :402-472, abpoa_msa1 :474-540, abpoa_output :355-371).
+"""
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import IO, List, Optional
+
+import numpy as np
+
+from . import constants as C
+from .align import align_sequence_to_graph, AlignResult
+from .cons.consensus import ConsensusResult, generate_consensus
+from .cons.msa import generate_rc_msa
+from .graph import POAGraph
+from .io.fastx import read_fastx
+from .io.output import generate_gfa, output_fx_consensus, output_rc_msa
+from .params import Params
+
+
+@dataclass
+class Abpoa:
+    """Top-level container (reference abpoa_t): graph + sequence metadata."""
+    graph: POAGraph = field(default_factory=POAGraph)
+    names: List[str] = field(default_factory=list)
+    comments: List[str] = field(default_factory=list)
+    quals: List[Optional[str]] = field(default_factory=list)
+    seqs: List[str] = field(default_factory=list)
+    is_rc: List[bool] = field(default_factory=list)
+    cons: Optional[ConsensusResult] = None
+
+    @property
+    def n_seq(self) -> int:
+        return len(self.seqs)
+
+    def reset(self) -> None:
+        self.graph.reset()
+        self.names, self.comments, self.quals = [], [], []
+        self.seqs, self.is_rc = [], []
+        self.cons = None
+
+
+def _rc_encode(seq: np.ndarray) -> np.ndarray:
+    rc = seq[::-1].copy()
+    lt4 = rc < 4
+    rc[lt4] = 3 - rc[lt4]
+    rc[~lt4] = 4
+    return rc
+
+
+def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarray],
+        exist_n_seq: int) -> None:
+    """Plain progressive POA, input order (src/abpoa_align.c:313-353)."""
+    g = ab.graph
+    n_seq = len(seqs)
+    tot_n_seq = exist_n_seq + n_seq
+    for i in range(n_seq):
+        qseq, weight = seqs[i], weights[i]
+        qlen = len(qseq)
+        read_id = exist_n_seq + i
+        res = AlignResult()
+        if g.node_n > 2:
+            res = align_sequence_to_graph(g, abpt, qseq)
+            if abpt.amb_strand and res.best_score < min(qlen, g.node_n - 2) * abpt.max_mat * 0.3333:
+                rc_qseq = _rc_encode(qseq)
+                rc_weight = weight[::-1].copy()
+                rc_res = align_sequence_to_graph(g, abpt, rc_qseq)
+                if rc_res.best_score > res.best_score:
+                    res = rc_res
+                    qseq, weight = rc_qseq, rc_weight
+                    ab.is_rc[read_id] = True
+        g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
+
+
+def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
+                      exist_n_seq: int) -> bool:
+    """Route the plain progressive loop through the single-dispatch all-device
+    path when the device backend is selected and the config is in scope
+    (align/fused_loop.py). Returns False to fall back to the per-read loop."""
+    if abpt.device not in ("jax", "tpu", "pallas") or exist_n_seq:
+        return False
+    from .align.fused_loop import fused_eligible, progressive_poa_fused
+    if not fused_eligible(abpt, len(seqs)):
+        return False
+    try:
+        pg, _ = progressive_poa_fused(seqs, weights, abpt)
+    except RuntimeError as e:
+        print(f"Warning: fused device loop failed ({e}); "
+              "falling back to the per-read loop.", file=sys.stderr)
+        return False
+    ab.graph = pg
+    return True
+
+
+def _want_native(abpt: Params) -> bool:
+    # native host core pairs with the device kernel; the numpy oracle reads
+    # Python Node objects directly, and the oracle-only corner flag needs it
+    if abpt.device == "native":
+        return not abpt.inc_path_score
+    return (abpt.device in ("jax", "tpu", "pallas")
+            and not abpt.inc_path_score and abpt.zdrop <= 0)
+
+
+def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
+    """File-level driver (reference abpoa_msa1)."""
+    assert abpt._finalized, "call Params.finalize() first"
+    if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
+        try:
+            from .native.graph import NativePOAGraph
+            ab.graph = NativePOAGraph()
+        except Exception:
+            pass
+    elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
+        ab.graph = POAGraph()
+    ab.reset()
+    if abpt.incr_fn:
+        from .io.restore import restore_graph
+        restore_graph(ab, abpt)
+    exist_n_seq = ab.n_seq
+    for rec in records:
+        ab.names.append(rec.name)
+        ab.comments.append(rec.comment)
+        ab.quals.append(rec.qual)
+        ab.seqs.append(rec.seq)
+        ab.is_rc.append(False)
+    n_seq = len(records)
+    if abpt.sort_input_seq:
+        order = sorted(range(n_seq), key=lambda i: -len(records[i].seq))
+        for attr in ("names", "comments", "quals", "seqs"):
+            lst = getattr(ab, attr)
+            lst[exist_n_seq:] = [lst[exist_n_seq + i] for i in order]
+
+    encode = abpt.char_to_code
+    seqs: List[np.ndarray] = []
+    weights: List[np.ndarray] = []
+    for i in range(n_seq):
+        s = ab.seqs[exist_n_seq + i]
+        arr = encode[np.frombuffer(s.encode(), dtype=np.uint8)].astype(np.uint8)
+        seqs.append(arr)
+        qual = ab.quals[exist_n_seq + i]
+        if abpt.use_qv and qual:
+            weights.append(np.frombuffer(qual.encode(), dtype=np.uint8).astype(np.int64) - 32)
+        else:
+            weights.append(np.ones(len(arr), dtype=np.int64))
+
+    if (abpt.disable_seeding and not abpt.progressive_poa) or abpt.align_mode != C.GLOBAL_MODE:
+        if not _run_fused_device(ab, abpt, seqs, weights, exist_n_seq):
+            poa(ab, abpt, seqs, weights, exist_n_seq)
+    else:
+        from .seed import anchor_poa_pipeline
+        anchor_poa_pipeline(ab, abpt, seqs, weights, exist_n_seq)
+
+    output(ab, abpt, out_fp)
+
+
+def output(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> None:
+    """(src/abpoa_align.c:355-371)"""
+    g = ab.graph
+    if getattr(g, "is_native", False):
+        g = g.to_python(abpt)  # output-time consumers walk Python nodes
+    if abpt.out_gfa:
+        generate_gfa(g, abpt, ab.names, ab.is_rc,
+                     lambda: generate_consensus(g, abpt, ab.n_seq), out_fp)
+    else:
+        if abpt.out_msa:
+            ab.cons = generate_rc_msa(g, abpt, ab.n_seq)
+        elif abpt.out_cons:
+            ab.cons = generate_consensus(g, abpt, ab.n_seq)
+            if not g.is_called_cons:
+                print("Warning: no consensus sequence generated.", file=sys.stderr)
+        if abpt.out_msa:
+            output_rc_msa(ab.cons, abpt, ab.names, ab.is_rc, out_fp)
+        elif abpt.out_cons:
+            output_fx_consensus(ab.cons, abpt, out_fp)
+    if abpt.out_pog:
+        from .io.plot import dump_pog
+        dump_pog(ab, abpt)
+
+
+def msa_from_file(ab: Abpoa, abpt: Params, path: str, out_fp: IO[str]) -> None:
+    if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
+        return
+    records = read_fastx(path)
+    msa(ab, abpt, records, out_fp)

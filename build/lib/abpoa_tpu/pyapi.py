@@ -1,0 +1,198 @@
+"""pyabpoa-compatible Python API.
+
+Mirrors /root/reference/python/pyabpoa.pyx: `msa_aligner` with one-shot
+`msa()` and incremental `msa_align()` / `msa_add()` / `msa_output()`, returning
+`msa_result` objects. Drives the same per-sequence granularity as the binding
+(align one read, fuse it, repeat) rather than the file-level driver.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from . import constants as C
+from .align import align_sequence_to_graph
+from .cons.consensus import generate_consensus
+from .cons.msa import generate_rc_msa
+from .graph import POAGraph
+from .params import Params
+from .pipeline import Abpoa
+
+
+class msa_result:
+    def __init__(self, n_seq, n_cons, clu_n_seq, clu_read_ids, cons_len,
+                 cons_seq, cons_cov, cons_qv, msa_len, msa_seq):
+        self.n_seq = n_seq
+        self.n_cons = n_cons
+        self.clu_n_seq = clu_n_seq
+        self.clu_read_ids = clu_read_ids
+        self.cons_len = cons_len
+        self.cons_seq = cons_seq
+        self.cons_cov = cons_cov
+        self.cons_qv = cons_qv
+        self.msa_len = msa_len
+        self.msa_seq = msa_seq
+
+    def print_msa(self) -> None:
+        if not self.msa_seq:
+            return
+        for i, s in enumerate(self.msa_seq):
+            if i < self.n_seq:
+                print(f">Seq_{i + 1}")
+            else:
+                cons_id = ""
+                if self.n_cons > 1:
+                    ids = ",".join(map(str, self.clu_read_ids[i - self.n_seq]))
+                    cons_id = f"_{i - self.n_seq + 1} {ids}"
+                print(f">Consensus_sequence{cons_id}")
+            print(s)
+
+
+class msa_aligner:
+    def __init__(self, aln_mode="g", is_aa=False, match=2, mismatch=4,
+                 score_matrix="", gap_open1=4, gap_open2=24, gap_ext1=2,
+                 gap_ext2=1, extra_b=10, extra_f=0.01, cons_algrm="HB",
+                 device="numpy"):
+        abpt = Params()
+        modes = {"g": C.GLOBAL_MODE, "l": C.LOCAL_MODE, "e": C.EXTEND_MODE}
+        if aln_mode not in modes:
+            raise ValueError(f"Unknown alignment mode: {aln_mode}")
+        abpt.align_mode = modes[aln_mode]
+        if is_aa:
+            abpt.m = 27
+        abpt.match = match
+        abpt.mismatch = mismatch
+        if score_matrix:
+            abpt.use_score_matrix = True
+            abpt.mat_fn = score_matrix if isinstance(score_matrix, str) \
+                else score_matrix.decode()
+        abpt.gap_open1, abpt.gap_open2 = gap_open1, gap_open2
+        abpt.gap_ext1, abpt.gap_ext2 = gap_ext1, gap_ext2
+        abpt.wb, abpt.wf = extra_b, extra_f
+        abpt.disable_seeding = True
+        abpt.progressive_poa = False
+        if cons_algrm.upper() == "MF":
+            abpt.cons_algrm = C.CONS_MF
+        elif cons_algrm.upper() == "HB":
+            abpt.cons_algrm = C.CONS_HB
+        else:
+            raise ValueError(f"Unknown consensus algorithm: {cons_algrm}")
+        abpt.device = device
+        self.abpt = abpt
+        self.ab = Abpoa()
+
+    # ------------------------------------------------------------- internals
+    def _add_sequences(self, seqs: List[str], qscores, exist_n: int, tot_n: int):
+        abpt = self.abpt
+        enc = abpt.char_to_code
+        g = self.ab.graph
+        if qscores is not None and len(qscores) != len(seqs):
+            raise ValueError("qscores must contain one entry per input sequence.")
+        for read_i, seq in enumerate(seqs):
+            bseq = enc[np.frombuffer(seq.encode(), dtype=np.uint8)].astype(np.uint8)
+            weights = None
+            if qscores is not None:
+                q = qscores[read_i]
+                if len(q) != len(seq):
+                    raise ValueError(
+                        "Each qscore array must have the same length as its sequence.")
+                weights = np.asarray(q, dtype=np.int64)
+                if (weights < 0).any():
+                    raise ValueError("Qscores must be non-negative integers.")
+            res = align_sequence_to_graph(g, abpt, bseq)
+            g.add_alignment(abpt, bseq, weights, None, res.cigar,
+                            exist_n + read_i, tot_n, True)
+            self.ab.names.append("")
+            self.ab.comments.append("")
+            self.ab.quals.append(None)
+            self.ab.seqs.append(seq)
+            self.ab.is_rc.append(False)
+
+    def _collect(self, n_seq: int) -> msa_result:
+        abpt = self.abpt
+        g = self.ab.graph
+        if getattr(g, "is_native", False):
+            g = g.to_python(abpt)
+        if abpt.out_msa:
+            abc = generate_rc_msa(g, abpt, n_seq)
+        elif abpt.out_cons:
+            abc = generate_consensus(g, abpt, n_seq)
+        else:
+            from .cons.consensus import ConsensusResult
+            abc = ConsensusResult(n_seq=n_seq)
+        decode = abpt.code_to_char
+        cons_seq = ["".join(chr(decode[b]) for b in row) for row in abc.cons_base]
+        cons_qv = ["".join(chr(q) for q in row) for row in abc.cons_phred]
+        msa_seq = []
+        if abc.msa_len > 0:
+            for row in abc.msa_base:
+                msa_seq.append("".join(chr(decode[b]) for b in row))
+        self.ab.cons = abc
+        return msa_result(n_seq, abc.n_cons, list(abc.clu_n_seq),
+                          [list(x) for x in abc.clu_read_ids], abc.cons_len,
+                          cons_seq, [list(c) for c in abc.cons_cov], cons_qv,
+                          abc.msa_len, msa_seq)
+
+    def _prepare(self, seqs, out_cons, out_msa, max_n_cons, min_freq, incr_fn,
+                 qscores):
+        abpt = self.abpt
+        abpt.out_cons = bool(out_cons)
+        abpt.out_msa = bool(out_msa)
+        if not 1 <= max_n_cons <= 2:
+            raise Exception("Error: max number of consensus sequences should be 1 or 2.")
+        abpt.max_n_cons = max_n_cons
+        abpt.min_freq = min_freq
+        abpt.use_qv = qscores is not None
+        abpt.finalize()
+        self.ab.reset()
+        exist_n = 0
+        if incr_fn:
+            abpt.incr_fn = incr_fn if isinstance(incr_fn, str) else incr_fn.decode()
+            from .io.restore import restore_graph
+            restore_graph(self.ab, abpt)  # works on both graph engines
+            exist_n = self.ab.n_seq
+        else:
+            abpt.incr_fn = None
+        return exist_n
+
+    # ------------------------------------------------------------ public API
+    def msa(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,
+            out_pog="", incr_fn="", qscores=None) -> msa_result:
+        abpt = self.abpt
+        abpt.out_pog = (out_pog if isinstance(out_pog, str) else out_pog.decode()) or None
+        exist_n = self._prepare(seqs, out_cons, out_msa, max_n_cons, min_freq,
+                                incr_fn, qscores)
+        tot_n = exist_n + len(seqs)
+        self._add_sequences(seqs, qscores, exist_n, tot_n)
+        result = self._collect(tot_n)
+        if abpt.out_pog:
+            from .io.plot import dump_pog
+            dump_pog(self.ab, abpt)
+        return result
+
+    def msa_align(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,
+                  incr_fn="", qscores=None) -> "msa_aligner":
+        exist_n = self._prepare(seqs, out_cons, out_msa, max_n_cons, min_freq,
+                                incr_fn, qscores)
+        tot_n = exist_n + len(seqs)
+        self._add_sequences(seqs, qscores, exist_n, tot_n)
+        return self
+
+    def msa_add(self, new_seqs, qscores=None) -> "msa_aligner":
+        if isinstance(new_seqs, str):
+            raise TypeError(
+                'Expected a list of strings. If you want to add a single sequence, '
+                'pass it as a list: ["ACGT..."]')
+        exist_n = self.ab.n_seq
+        if exist_n == 0:
+            raise Exception("Error: no existing sequences in the graph. "
+                            "Please run msa() or msa_align() first.")
+        if qscores is not None:
+            self.abpt.use_qv = True
+        tot_n = exist_n + len(new_seqs)
+        self._add_sequences(new_seqs, qscores, exist_n, tot_n)
+        return self
+
+    def msa_output(self) -> msa_result:
+        return self._collect(self.ab.n_seq)

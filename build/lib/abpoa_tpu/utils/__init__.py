@@ -1,0 +1,1 @@
+from .logging import vlog, set_verbose, timer, trace_annotation, run_stats

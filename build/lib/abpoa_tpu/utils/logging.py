@@ -1,0 +1,65 @@
+"""Tracing / profiling utilities.
+
+TPU-native equivalent of the reference's verbosity ladder + stderr traces +
+end-of-run resource line (SURVEY.md §5; reference include/abpoa.h:40-43,
+src/utils.h:120-126, src/abpoa.c:166): a `verbose` ladder gating structured
+stderr logs, wall/CPU timers, peak-RSS reporting, and `jax.profiler` trace
+annotations around kernel dispatches for profiling with TensorBoard/XProf.
+"""
+from __future__ import annotations
+
+import contextlib
+import resource
+import sys
+import time
+from typing import Iterator
+
+from .. import constants as C
+
+_VERBOSE = C.VERBOSE_NONE
+
+
+def set_verbose(level: int) -> None:
+    global _VERBOSE
+    _VERBOSE = level
+
+
+def vlog(level: int, msg: str, func: str = "") -> None:
+    """Verbosity-gated stderr log (reference err_func_printf style)."""
+    if _VERBOSE >= level:
+        prefix = f"[abpoa_tpu::{func}] " if func else "[abpoa_tpu] "
+        print(prefix + msg, file=sys.stderr)
+
+
+@contextlib.contextmanager
+def timer(label: str, level: int = C.VERBOSE_INFO) -> Iterator[None]:
+    t0 = time.time()
+    c0 = time.process_time()
+    try:
+        yield
+    finally:
+        vlog(level, f"{label}: real {time.time() - t0:.3f} s; "
+                    f"CPU {time.process_time() - c0:.3f} s")
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str) -> Iterator[None]:
+    """jax.profiler annotation (no-op if jax missing/uninitialized)."""
+    try:
+        import jax
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield
+
+
+def peak_rss_gb() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_maxrss / 1024.0 / 1024.0  # linux reports KB
+
+
+def run_stats(t0: float, c0: float) -> str:
+    """End-of-run line mirroring the reference's wall/CPU/RSS report."""
+    return (f"Real time: {time.time() - t0:.3f} sec; "
+            f"CPU: {time.process_time() - c0:.3f} sec; "
+            f"Peak RSS: {peak_rss_gb():.3f} GB.")
