@@ -12,7 +12,6 @@
 #include <cstring>
 #include <cmath>
 #include <vector>
-#include <deque>
 #include <algorithm>
 
 namespace {
@@ -40,6 +39,7 @@ struct Graph {
     std::vector<int32_t> ws_qprof;  // per-alignment query profile (m x qlen+1)
     std::vector<int32_t> ws_pre, ws_pre_off;  // flattened per-row pred lists
     std::vector<uint8_t> ws_index_map;
+    std::vector<int32_t> ws_queue, ws_degree;  // BFS scratch (topo sort)
     std::vector<int64_t> ws_row_ptr;
     std::vector<int32_t> ws_beg, ws_end;
 
@@ -137,15 +137,21 @@ void sort_in_out_ids(Graph& g) {
 }
 
 bool bfs_set_node_index(Graph& g) {
+    // flat FIFO over a persistent workspace (identical order to the former
+    // std::deque; every node is enqueued at most once so n slots suffice)
     int n = g.n();
     g.index_to_node_id.assign(n, 0);
     g.node_id_to_index.assign(n, 0);
-    std::vector<int32_t> in_degree(n);
+    std::vector<int32_t>& in_degree = g.ws_degree;
+    in_degree.resize(n);
     for (int i = 0; i < n; ++i) in_degree[i] = (int)g.nodes[i].in_ids.size();
-    std::deque<int> q{SRC};
+    std::vector<int32_t>& q = g.ws_queue;
+    if ((int)q.size() < n) q.resize(n);
+    int head = 0, tail = 0;
+    q[tail++] = SRC;
     int index = 0;
-    while (!q.empty()) {
-        int cur = q.front(); q.pop_front();
+    while (head < tail) {
+        int cur = q[head++];
         g.index_to_node_id[index] = cur;
         g.node_id_to_index[cur] = index++;
         if (cur == SINK) return true;
@@ -155,8 +161,8 @@ bool bfs_set_node_index(Graph& g) {
                 for (int a : g.nodes[out_id].aligned_ids)
                     if (in_degree[a] != 0) { ok = false; break; }
                 if (!ok) continue;
-                q.push_back(out_id);
-                for (int a : g.nodes[out_id].aligned_ids) q.push_back(a);
+                q[tail++] = out_id;
+                for (int a : g.nodes[out_id].aligned_ids) q[tail++] = a;
             }
         }
     }
@@ -166,12 +172,16 @@ bool bfs_set_node_index(Graph& g) {
 bool bfs_set_node_remain(Graph& g) {
     int n = g.n();
     g.max_remain.assign(n, 0);
-    std::vector<int32_t> out_degree(n);
+    std::vector<int32_t>& out_degree = g.ws_degree;
+    out_degree.resize(n);
     for (int i = 0; i < n; ++i) out_degree[i] = (int)g.nodes[i].out_ids.size();
-    std::deque<int> q{SINK};
+    std::vector<int32_t>& q = g.ws_queue;
+    if ((int)q.size() < n) q.resize(n);
+    int head = 0, tail = 0;
+    q[tail++] = SINK;
     g.max_remain[SINK] = -1;
-    while (!q.empty()) {
-        int cur = q.front(); q.pop_front();
+    while (head < tail) {
+        int cur = q[head++];
         Node& node = g.nodes[cur];
         if (cur != SINK) {
             int max_w = -1, max_id = -1;
@@ -181,7 +191,7 @@ bool bfs_set_node_remain(Graph& g) {
         }
         if (cur == SRC) return true;
         for (int in_id : node.in_ids)
-            if (--out_degree[in_id] == 0) q.push_back(in_id);
+            if (--out_degree[in_id] == 0) q[tail++] = in_id;
     }
     return false;
 }
