@@ -36,6 +36,21 @@ def _resolve(abpt: Params) -> Callable:
         if name == "native":
             from . import native_backend  # registers "native"
         else:
+            # a wedged accelerator tunnel hangs the first in-process
+            # jax.devices() forever; probe out-of-process first so the CLI
+            # degrades to the host kernel instead (the reference's dispatch
+            # can never hang, src/abpoa_dispatch_simd.c:56-78)
+            from ..utils.probe import jax_backend_reachable, warn_unreachable_once
+            if not jax_backend_reachable():
+                warn_unreachable_once(
+                    "Warning: JAX backend probe timed out (wedged "
+                    "accelerator tunnel?); using the host kernel.")
+                try:
+                    from . import native_backend  # registers "native"
+                    name = "native"
+                except Exception:
+                    name = "numpy"
+                return _BACKENDS[name]
             from . import jax_backend  # lazy: registers "jax"
             if name == "pallas":
                 from . import pallas_backend  # registers "pallas"
@@ -70,8 +85,13 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
         g.topological_sort(abpt)
     fn = _resolve(abpt)  # also validates the backend name
     if len(windows) > 1 and abpt.device in ("jax", "tpu", "pallas"):
-        from .jax_backend import align_windows_jax
-        return align_windows_jax(g, abpt, windows)
+        # _resolve may have fallen back to a host kernel on a failed probe;
+        # the batched-window path must honor that too or it would hang on
+        # the same wedged backend init the probe just detected
+        from ..utils.probe import jax_backend_reachable
+        if jax_backend_reachable():
+            from .jax_backend import align_windows_jax
+            return align_windows_jax(g, abpt, windows)
     return [fn(g, abpt, b, e, q) for b, e, q in windows]
 
 

@@ -238,27 +238,54 @@ class NativePOAGraph:
                        _ptr(bits_words, ctypes.c_int64))
         g = POAGraph()
         g.nodes = []
-        edge_i = 0
-        for i in range(n):
-            nd = Node(i, int(base[i]))
-            nd.in_ids = [int(x) for x in in_ids[in_off[i]: in_off[i + 1]]]
-            nd.in_w = [int(x) for x in in_w[in_off[i]: in_off[i + 1]]]
-            nd.out_ids = [int(x) for x in out_ids[out_off[i]: out_off[i + 1]]]
-            nd.out_w = [int(x) for x in out_w[out_off[i]: out_off[i + 1]]]
-            nd.aligned_ids = [int(x) for x in al_ids[al_off[i]: al_off[i + 1]]]
-            nd.n_read = int(n_read[i])
-            nd.n_span_read = int(n_span[i])
-            nd.read_weight = {int(r): int(v) for r, v in
-                              zip(rw_ids[rw_off[i]: rw_off[i + 1]],
-                                  rw_vals[rw_off[i]: rw_off[i + 1]])}
-            for _ in nd.out_ids:
-                wn = int(bits_words[edge_i])
-                off = int(bits_off[edge_i])
+        # bulk-convert once: ndarray.tolist() is ~30x faster than per-element
+        # int() casts, and list slicing below is O(len) C-speed (this export
+        # runs once per read set but dominated the small-workload wall)
+        base_l = base.tolist()
+        n_read_l = n_read.tolist()
+        n_span_l = n_span.tolist()
+        in_off_l = in_off.tolist()
+        in_ids_l = in_ids.tolist()
+        in_w_l = in_w.tolist()
+        out_off_l = out_off.tolist()
+        out_ids_l = out_ids.tolist()
+        out_w_l = out_w.tolist()
+        al_off_l = al_off.tolist()
+        al_ids_l = al_ids.tolist()
+        rw_off_l = rw_off.tolist()
+        # per-edge read-id bitset words -> arbitrary-precision ints
+        words_l = bits_words.tolist()
+        boff_l = bits_off.tolist()
+        bits_l = bits.tolist()
+        read_all = [0] * tout
+        for e in range(tout):
+            wn = words_l[e]
+            if wn == 1:
+                read_all[e] = bits_l[boff_l[e]]
+            elif wn > 1:
                 v = 0
+                off = boff_l[e]
                 for k in range(wn):
-                    v |= int(bits[off + k]) << (64 * k)
-                nd.read_ids.append(v)
-                edge_i += 1
+                    v |= bits_l[off + k] << (64 * k)
+                read_all[e] = v
+        any_rw = trw > 0
+        if any_rw:
+            rw_ids_l = rw_ids.tolist()
+            rw_vals_l = rw_vals.tolist()
+        for i in range(n):
+            nd = Node(i, base_l[i])
+            nd.in_ids = in_ids_l[in_off_l[i]: in_off_l[i + 1]]
+            nd.in_w = in_w_l[in_off_l[i]: in_off_l[i + 1]]
+            oo, oo2 = out_off_l[i], out_off_l[i + 1]
+            nd.out_ids = out_ids_l[oo:oo2]
+            nd.out_w = out_w_l[oo:oo2]
+            nd.aligned_ids = al_ids_l[al_off_l[i]: al_off_l[i + 1]]
+            nd.n_read = n_read_l[i]
+            nd.n_span_read = n_span_l[i]
+            if any_rw:
+                nd.read_weight = dict(zip(rw_ids_l[rw_off_l[i]: rw_off_l[i + 1]],
+                                          rw_vals_l[rw_off_l[i]: rw_off_l[i + 1]]))
+            nd.read_ids = read_all[oo:oo2]
             g.nodes.append(nd)
         g.is_topological_sorted = self.is_topological_sorted
         if g.is_topological_sorted:

@@ -47,7 +47,6 @@ def shard_dp_batch(mesh_devices: int = None):
     mesh slot. Used by __graft_entry__.dryrun_multichip and as the scaffold for
     multi-set batch processing.
     """
-    from jax.experimental.shard_map import shard_map
     from ..align.jax_backend import _dp_scan
     from .. import constants as C
 
@@ -72,8 +71,8 @@ def shard_dp_batch(mesh_devices: int = None):
 
     @jax.jit
     def step(*stacked):
-        fn = shard_map(jax.vmap(one_set), mesh=mesh, in_specs=specs,
-                       out_specs=P("set"), check_rep=False)
+        fn = jax.shard_map(jax.vmap(one_set), mesh=mesh, in_specs=specs,
+                           out_specs=P("set"), check_vma=False)
         return fn(*stacked)
 
     return mesh, step

@@ -82,6 +82,12 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     (align/fused_loop.py). Returns False to fall back to the per-read loop."""
     if abpt.device not in ("jax", "tpu", "pallas") or exist_n_seq:
         return False
+    from .utils.probe import jax_backend_reachable, warn_unreachable_once
+    if not jax_backend_reachable():
+        warn_unreachable_once(
+            "Warning: JAX backend probe timed out (wedged accelerator "
+            "tunnel?); falling back to the host engine.")
+        return False
     from .align.fused_loop import fused_eligible, progressive_poa_fused
     if not fused_eligible(abpt, len(seqs)):
         return False

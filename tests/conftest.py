@@ -7,6 +7,9 @@ import sys
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the suite runs CPU-only: skip the out-of-process accelerator liveness probe
+# (tests/test_probe.py exercises the probe itself and clears this)
+os.environ["ABPOA_TPU_SKIP_PROBE"] = "1"
 
 
 def _drop_accelerator_plugins():

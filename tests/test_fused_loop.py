@@ -131,6 +131,60 @@ def test_fused_read_id_outputs(flags):
     assert cli("jax") == cli("numpy")
 
 
+@pytest.mark.parametrize("gap", ["convex", "affine"])
+def test_fused_random_reads_consensus_matches(gap):
+    """Random-read consensus parity vs the host loop (ported from the retired
+    round-1 device_pipeline prototype when it was deleted)."""
+    from test_device_graph import _random_reads
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused
+    from abpoa_tpu.cons.consensus import generate_consensus
+    from abpoa_tpu.pipeline import Abpoa, poa
+
+    rng = np.random.default_rng(11)
+    reads = _random_reads(rng, 6, 140)
+    abpt = Params()
+    abpt.device = "numpy"
+    if gap == "affine":
+        abpt.gap_open2 = 0
+    abpt.finalize()
+
+    ab = Abpoa()
+    for r in reads:
+        ab.names.append("")
+        ab.comments.append("")
+        ab.quals.append(None)
+        ab.seqs.append("x" * len(r))
+        ab.is_rc.append(False)
+    weights = [np.ones(len(r), dtype=np.int64) for r in reads]
+    poa(ab, abpt, reads, weights, 0)
+    cons_host = generate_consensus(ab.graph, abpt, len(reads)).cons_base
+
+    pg, _ = progressive_poa_fused(reads, weights, abpt)
+    cons_dev = generate_consensus(pg, abpt, len(reads)).cons_base
+    assert cons_host == cons_dev
+
+
+def test_fused_read_id_collision_rate_sim2k():
+    """Read-id replay forfeits the device win whenever a sequential-fusion
+    collision fires (progressive_poa_fused raises and pipeline falls back to
+    the host loop). Pin the collision frequency on realistic data at zero so a
+    regression that starts tripping the fallback is caught by CI."""
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused
+    path = os.path.join(DATA_DIR, "sim2k.fa")
+    abpt = Params()
+    abpt.out_msa = True          # forces use_read_ids in finalize()
+    abpt.finalize()
+    assert abpt.use_read_ids
+    recs = read_fastx(path)
+    enc = abpt.char_to_code
+    seqs = [enc[np.frombuffer(r.seq.encode(), dtype=np.uint8)].astype(np.uint8)
+            for r in recs]
+    wgts = [np.ones(len(s), dtype=np.int64) for s in seqs]
+    # raises RuntimeError if any collision fallback fired
+    pg, _ = progressive_poa_fused(seqs, wgts, abpt)
+    assert pg.node_n > 2
+
+
 def test_fused_pipeline_wiring():
     """device=jax routes the plain progressive loop through the fused path."""
     path = os.path.join(DATA_DIR, "seq.fa")
