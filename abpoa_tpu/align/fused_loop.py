@@ -54,6 +54,7 @@ grows the bucket and resumes from the returned device state (no work is lost).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -81,6 +82,20 @@ ERR_GRAPH_CAP = 7    # capacity hit inside the sequential fusion/Kahn fallback
 #                      (no specific dimension reported) -> grow N, E and A
 ERR_PROMOTE = 8      # int16 score bound exceeded -> switch planes to int32
 
+# While-loop body unrolling. Each loop iteration processes this many DP rows /
+# backtrack ops, masked at boundaries. Semantics are identical (overshoot rows
+# are inactive no-ops; overshoot ops are predicated off); the win is k x fewer
+# sequential loop iterations. Measured on the CPU backend (PERF.md):
+# BT_UNROLL=6 is free (1.9s -> 2.0s on sim2k) and cuts the ~5M backtrack
+# iterations of the north-star run 6x; DP unrolling is superlinearly SLOWER
+# on CPU even with block commits (K=2: 1.4x, K=4: 4x), so it defaults off
+# until it can be measured on a real chip — flip via ABPOA_TPU_DP_UNROLL.
+# Chain-run carrying (VERDICT r2 idea) was measured unviable: only 4% of rows
+# in the spliced order qualify (single pred at i-1 AND prev out-degree 1)
+# because saturated POA backbone nodes keep multiple out-edges — see PERF.md.
+DP_UNROLL = max(1, int(os.environ.get("ABPOA_TPU_DP_UNROLL", "1")))
+BT_UNROLL = max(1, int(os.environ.get("ABPOA_TPU_BT_UNROLL", "6")))
+
 
 class FusedState(NamedTuple):
     g: DeviceGraph
@@ -93,10 +108,11 @@ class FusedState(NamedTuple):
     paths: jnp.ndarray    # (n_reads, Pcap) each read's fusion path node ids
     path_lens: jnp.ndarray  # (n_reads,)
     collisions: jnp.ndarray  # () int32: sequential-fusion fallbacks taken
+    rc_flags: jnp.ndarray  # (n_rc,) int32: 1 where amb-strand used the RC
 
 
 def init_fused_state(N: int, E: int, A: int, n_reads: int = 1,
-                     Pcap: int = 8) -> FusedState:
+                     Pcap: int = 8, n_rc: int = 1) -> FusedState:
     return FusedState(
         g=init_device_graph(N, E, A),
         order=jnp.zeros(N, jnp.int32),
@@ -107,7 +123,8 @@ def init_fused_state(N: int, E: int, A: int, n_reads: int = 1,
         kahn_runs=jnp.int32(0),
         paths=jnp.zeros((n_reads, Pcap), jnp.int32),
         path_lens=jnp.zeros(n_reads, jnp.int32),
-        collisions=jnp.int32(0))
+        collisions=jnp.int32(0),
+        rc_flags=jnp.zeros(max(n_rc, 1), jnp.int32))
 
 
 # --------------------------------------------------------------------------- #
@@ -165,21 +182,33 @@ def _remain_doubling(g: DeviceGraph) -> jnp.ndarray:
 # banded DP over graph rows                                                   #
 # --------------------------------------------------------------------------- #
 
-def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf):
-    """Row-0 (source row) plane windows for the convex-global regime
+def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf,
+                 gap_mode: int = C.CONVEX_GAP):
+    """Row-0 (source row) plane windows per gap regime
     (abpoa_align_simd.c:582-688). Single source of truth — used by both
     _dp_banded's init and the Pallas path. Dtype follows the scalars."""
     dt = jnp.asarray(o1).dtype
     kw = jnp.arange(W, dtype=jnp.int32)
     kw_dt = kw.astype(dt)
     colv = kw <= dp_end0
-    f1r = -o1 - e1 * kw_dt
-    f2r = -o2 - e2 * kw_dt
-    F10 = jnp.where(colv & (kw >= 1), f1r, inf)
-    F20 = jnp.where(colv & (kw >= 1), f2r, inf)
-    H0 = jnp.where(colv & (kw >= 1), jnp.maximum(f1r, f2r), inf).at[0].set(0)
-    E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
-    E20 = jnp.full(W, inf, dt).at[0].set(-oe2)
+    if gap_mode == C.LINEAR_GAP:
+        H0 = jnp.where(colv, -e1 * kw_dt, inf)
+        E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
+    elif gap_mode == C.CONVEX_GAP:
+        f1r = -o1 - e1 * kw_dt
+        f2r = -o2 - e2 * kw_dt
+        F10 = jnp.where(colv & (kw >= 1), f1r, inf)
+        F20 = jnp.where(colv & (kw >= 1), f2r, inf)
+        H0 = jnp.where(colv & (kw >= 1), jnp.maximum(f1r, f2r), inf).at[0].set(0)
+        E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+        E20 = jnp.full(W, inf, dt).at[0].set(-oe2)
+    else:  # affine
+        f1r = -o1 - e1 * kw_dt
+        F10 = jnp.where(colv & (kw >= 1), f1r, inf)
+        F20 = jnp.full(W, inf, dt)
+        H0 = jnp.where(colv & (kw >= 1), f1r, inf).at[0].set(0)
+        E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
+        E20 = jnp.full(W, inf, dt)
     return H0, E10, E20, F10, F20
 
 @functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16"))
@@ -209,23 +238,11 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     convex = gap_mode == C.CONVEX_GAP
     linear = gap_mode == C.LINEAR_GAP
     kw = jnp.arange(W, dtype=jnp.int32)
-    kw_dt = kw.astype(dt)
 
     # ---- first row: absolute cols [0, dp_end0] ------------------------------
-    colv = kw <= dp_end0
-    if linear:
-        H0 = jnp.where(colv, -e1 * kw_dt, inf)
-        E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
-    elif convex:
-        H0, E10, E20, F10, F20 = _row0_planes(
-            W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf)
-    else:
-        f1r = -o1 - e1 * kw_dt
-        F10 = jnp.where(colv & (kw >= 1), f1r, inf)
-        F20 = jnp.full(W, inf, dt)
-        H0 = jnp.where(colv & (kw >= 1), f1r, inf).at[0].set(0)
-        E10 = jnp.full(W, inf, dt).at[0].set(-oe1)
-        E20 = jnp.full(W, inf, dt)
+    # single source of truth shared with the Pallas caller (_row0_planes)
+    H0, E10, E20, F10, F20 = _row0_planes(
+        W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf, gap_mode=gap_mode)
 
     Hb = jnp.full((R, W), inf, dt).at[0].set(H0)
     E1b = jnp.full((R, W), inf, dt).at[0].set(E10)
@@ -252,108 +269,168 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 break
         return F
 
-    def pre_window(plane, dp_beg_cur, pidx, pm, abs_cols, inf):
+    # Block-commit unrolling: each while-loop iteration computes DP_UNROLL
+    # consecutive rows. Every read of the big plane buffers uses their
+    # start-of-iteration version; sub-rows see each other through small
+    # register-level overlays, and the iteration ends with ONE contiguous
+    # (K, W) dynamic-update-slice per buffer. This keeps XLA's in-place
+    # update of the loop-carried planes intact (chained per-row .at[i].set
+    # inside one body forced full-plane copies: measured 25x slower on the
+    # CPU backend) and avoids TPU read-after-write on just-written HBM.
+    # The planes carry K padding rows so the final block write never clamps.
+    K = DP_UNROLL
+    pad_rows = jnp.full((K, W), inf, dt)
+    Hb = jnp.concatenate([Hb, pad_rows])
+    E1b = jnp.concatenate([E1b, pad_rows])
+    E2b = jnp.concatenate([E2b, pad_rows])
+    F1b = jnp.concatenate([F1b, pad_rows])
+    F2b = jnp.concatenate([F2b, pad_rows])
+    pad_i = jnp.zeros(K, jnp.int32)
+    dp_beg = jnp.concatenate([dp_beg, pad_i])
+    dp_end = jnp.concatenate([dp_end, pad_i])
+
+    def pre_window(plane, pidx, pm, pb, abs_cols, inf):
         """Gather predecessor plane cells at absolute columns (P, W).
 
-        dp_beg_cur must be the loop-carried band begins (NOT the initial
-        array) so each predecessor row's window offset is current."""
+        pb holds each predecessor row's CURRENT band begin (big-array value
+        overlaid with this iteration's local sub-rows by the caller)."""
         pw = plane[pidx]                                   # (P, W)
-        idx = abs_cols[None, :] - dp_beg_cur[pidx][:, None]  # (P, W) window index
+        idx = abs_cols[None, :] - pb[:, None]              # (P, W) window index
         ok = pm[:, None] & (idx >= 0) & (idx < W)
         v = jnp.take_along_axis(pw, jnp.clip(idx, 0, W - 1), axis=1)
         return jnp.where(ok, v, inf)
 
+    def overlay(v, lrows, pidx, pm, i0, t, lbeg, abs_cols, inf):
+        """Replace predecessor windows that refer to rows computed earlier in
+        this same iteration (local sub-rows) with their register values."""
+        for s in range(t):
+            m = pm & (pidx == i0 + s)
+            idx_s = abs_cols - lbeg[s]
+            ok_s = (idx_s >= 0) & (idx_s < W)
+            v_s = jnp.where(ok_s, lrows[s][jnp.clip(idx_s, 0, W - 1)], inf)
+            v = jnp.where(m[:, None], v_s[None, :], v)
+        return v
+
     def body(st):
-        (i, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
-        active = row_active[i]
-        pm = pre_msk[i]
-        pidx = pre_idx[i]
+        (i0, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
+        lH = []
+        lE1 = []
+        lE2 = []
+        lF1 = []
+        lF2 = []
+        lbeg = []
+        lend = []
+        for t in range(K):
+            i = i0 + t
+            active = row_active[i]
+            pm = pre_msk[i]
+            pidx = pre_idx[i]
 
-        # ---- band ----------------------------------------------------------
-        r = qlen - (remain_rows[i] - remain_end - 1)
-        beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
-        end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
-        min_pre_beg = jnp.min(jnp.where(pm, dp_beg[pidx], jnp.int32(2**30)))
-        beg = jnp.maximum(beg, min_pre_beg)
-        overflow = overflow | (active & (end - beg + 1 > W))
-        abs_cols = beg + kw
-        in_band = abs_cols <= end
+            # ---- band ------------------------------------------------------
+            r = qlen - (remain_rows[i] - remain_end - 1)
+            beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
+            end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
+            pb = dp_beg[pidx]
+            for s in range(t):
+                pb = jnp.where(pidx == i0 + s, lbeg[s], pb)
+            min_pre_beg = jnp.min(jnp.where(pm, pb, jnp.int32(2**30)))
+            beg = jnp.maximum(beg, min_pre_beg)
+            overflow = overflow | (active & (end - beg + 1 > W))
+            abs_cols = beg + kw
+            in_band = abs_cols <= end
 
-        # ---- M / E from predecessors --------------------------------------
-        Hm1 = pre_window(Hb, dp_beg, pidx, pm, abs_cols - 1, inf)  # H[pre][j-1]
-        # the lead cell (absolute col -1) of a predecessor row never exists;
-        # global first col handled by row-0 init, so OOB stays inf
-        Mq = jnp.max(Hm1, axis=0)
-        if linear:
-            Hj = pre_window(Hb, dp_beg, pidx, pm, abs_cols, inf)
-            Erow = jnp.max(Hj - e1, axis=0)
-        else:
-            Erow = jnp.max(pre_window(E1b, dp_beg, pidx, pm, abs_cols, inf), axis=0)
-            if convex:
-                E2row = jnp.max(pre_window(E2b, dp_beg, pidx, pm, abs_cols, inf), axis=0)
-
-        Mq = Mq + qp[base_r[i], jnp.clip(abs_cols, 0, qp.shape[1] - 1)]
-        Mq = jnp.where(in_band, Mq, inf)
-        Erow = jnp.where(in_band, Erow, inf)
-        Hhat = jnp.maximum(Mq, Erow)
-        if convex:
-            E2row = jnp.where(in_band, E2row, inf)
-            Hhat = jnp.maximum(Hhat, E2row)
-
-        if linear:
-            Hrow = chain_max(Hhat, e1)
-            Hrow = jnp.where(in_band, Hrow, inf)
-            E1n = E2n = F1n = F2n = jnp.full(W, inf, dt)
-        else:
-            Hm1w = jnp.concatenate([jnp.full(1, inf, dt), Hhat[:-1]])
-            A1 = jnp.where(kw == 0, Mq - oe1, Hm1w - oe1)
-            A1 = jnp.where(in_band, A1, inf)
-            F1n = chain_max(A1, e1)
-            Hrow = jnp.maximum(Hhat, F1n)
-            if convex:
-                A2 = jnp.where(kw == 0, Mq - oe2, Hm1w - oe2)
-                A2 = jnp.where(in_band, A2, inf)
-                F2n = chain_max(A2, e2)
-                Hrow = jnp.maximum(Hrow, F2n)
+            # ---- M / E from predecessors -----------------------------------
+            # the lead cell (absolute col -1) of a predecessor row never
+            # exists; global first col handled by row-0 init, so OOB stays inf
+            Hm1 = overlay(pre_window(Hb, pidx, pm, pb, abs_cols - 1, inf),
+                          lH, pidx, pm, i0, t, lbeg, abs_cols - 1, inf)
+            Mq = jnp.max(Hm1, axis=0)
+            if linear:
+                Hj = overlay(pre_window(Hb, pidx, pm, pb, abs_cols, inf),
+                             lH, pidx, pm, i0, t, lbeg, abs_cols, inf)
+                Erow = jnp.max(Hj - e1, axis=0)
             else:
-                F2n = jnp.full(W, inf, dt)
-            if gap_mode == C.AFFINE_GAP:
-                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
-                E1n = jnp.where(Hrow == Hhat, E1n, inf)
-                E2n = jnp.full(W, inf, dt)
+                Erow = jnp.max(
+                    overlay(pre_window(E1b, pidx, pm, pb, abs_cols, inf),
+                            lE1, pidx, pm, i0, t, lbeg, abs_cols, inf), axis=0)
+                if convex:
+                    E2row = jnp.max(
+                        overlay(pre_window(E2b, pidx, pm, pb, abs_cols, inf),
+                                lE2, pidx, pm, i0, t, lbeg, abs_cols, inf),
+                        axis=0)
+
+            Mq = Mq + qp[base_r[i], jnp.clip(abs_cols, 0, qp.shape[1] - 1)]
+            Mq = jnp.where(in_band, Mq, inf)
+            Erow = jnp.where(in_band, Erow, inf)
+            Hhat = jnp.maximum(Mq, Erow)
+            if convex:
+                E2row = jnp.where(in_band, E2row, inf)
+                Hhat = jnp.maximum(Hhat, E2row)
+
+            if linear:
+                Hrow = chain_max(Hhat, e1)
+                Hrow = jnp.where(in_band, Hrow, inf)
+                E1n = E2n = F1n = F2n = jnp.full(W, inf, dt)
             else:
-                E1n = jnp.maximum(Erow - e1, Hrow - oe1)
-                E2n = jnp.maximum(E2row - e2, Hrow - oe2)
-            E1n = jnp.where(in_band, E1n, inf)
-            E2n = jnp.where(in_band, E2n, inf)
-            F1n = jnp.where(in_band, F1n, inf)
-            F2n = jnp.where(in_band, F2n, inf)
-            Hrow = jnp.where(in_band, Hrow, inf)
+                Hm1w = jnp.concatenate([jnp.full(1, inf, dt), Hhat[:-1]])
+                A1 = jnp.where(kw == 0, Mq - oe1, Hm1w - oe1)
+                A1 = jnp.where(in_band, A1, inf)
+                F1n = chain_max(A1, e1)
+                Hrow = jnp.maximum(Hhat, F1n)
+                if convex:
+                    A2 = jnp.where(kw == 0, Mq - oe2, Hm1w - oe2)
+                    A2 = jnp.where(in_band, A2, inf)
+                    F2n = chain_max(A2, e2)
+                    Hrow = jnp.maximum(Hrow, F2n)
+                else:
+                    F2n = jnp.full(W, inf, dt)
+                if gap_mode == C.AFFINE_GAP:
+                    E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                    E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                    E2n = jnp.full(W, inf, dt)
+                else:
+                    E1n = jnp.maximum(Erow - e1, Hrow - oe1)
+                    E2n = jnp.maximum(E2row - e2, Hrow - oe2)
+                E1n = jnp.where(in_band, E1n, inf)
+                E2n = jnp.where(in_band, E2n, inf)
+                F1n = jnp.where(in_band, F1n, inf)
+                F2n = jnp.where(in_band, F2n, inf)
+                Hrow = jnp.where(in_band, Hrow, inf)
 
-        # ---- row max -> adaptive band propagation --------------------------
-        vals = jnp.where(in_band, Hrow, inf)
-        mx = jnp.max(vals)
-        has = mx > inf
-        eq = (vals == mx) & in_band
-        left = jnp.where(has, beg + jnp.argmax(eq), -1).astype(jnp.int32)
-        right = jnp.where(has, beg + W - 1 - jnp.argmax(eq[::-1]), -1).astype(jnp.int32)
-        om = out_msk[i] & active
-        tgt = jnp.where(om, out_idx[i], R)
-        mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
-        mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
+            # ---- row max -> adaptive band propagation ----------------------
+            vals = jnp.where(in_band, Hrow, inf)
+            mx = jnp.max(vals)
+            has = mx > inf
+            eq = (vals == mx) & in_band
+            left = jnp.where(has, beg + jnp.argmax(eq), -1).astype(jnp.int32)
+            right = jnp.where(has, beg + W - 1 - jnp.argmax(eq[::-1]),
+                              -1).astype(jnp.int32)
+            om = out_msk[i] & active
+            tgt = jnp.where(om, out_idx[i], R)
+            mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
+            mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
 
-        # ---- commit --------------------------------------------------------
-        keep = active
-        Hb = Hb.at[i].set(jnp.where(keep, Hrow, Hb[i]))
+            # ---- local commit (inactive rows write discarded padding) ------
+            lH.append(jnp.where(active, Hrow, inf))
+            lE1.append(jnp.where(active, E1n, inf))
+            lE2.append(jnp.where(active, E2n, inf))
+            lF1.append(jnp.where(active, F1n, inf))
+            lF2.append(jnp.where(active, F2n, inf))
+            lbeg.append(jnp.where(active, beg, 0))
+            lend.append(jnp.where(active, end, 0))
+
+        # ---- block commit: one contiguous write per buffer -----------------
+        Hb = lax.dynamic_update_slice(Hb, jnp.stack(lH), (i0, 0))
         if not linear:
-            E1b = E1b.at[i].set(jnp.where(keep, E1n, E1b[i]))
-            F1b = F1b.at[i].set(jnp.where(keep, F1n, F1b[i]))
+            E1b = lax.dynamic_update_slice(E1b, jnp.stack(lE1), (i0, 0))
+            F1b = lax.dynamic_update_slice(F1b, jnp.stack(lF1), (i0, 0))
             if convex:
-                E2b = E2b.at[i].set(jnp.where(keep, E2n, E2b[i]))
-                F2b = F2b.at[i].set(jnp.where(keep, F2n, F2b[i]))
-        dp_beg = dp_beg.at[i].set(jnp.where(keep, beg, dp_beg[i]))
-        dp_end = dp_end.at[i].set(jnp.where(keep, end, dp_end[i]))
-        return (i + 1, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow)
+                E2b = lax.dynamic_update_slice(E2b, jnp.stack(lE2), (i0, 0))
+                F2b = lax.dynamic_update_slice(F2b, jnp.stack(lF2), (i0, 0))
+        dp_beg = lax.dynamic_update_slice(dp_beg, jnp.stack(lbeg), (i0,))
+        dp_end = lax.dynamic_update_slice(dp_end, jnp.stack(lend), (i0,))
+        return (i0 + K, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                overflow)
 
     def cond(st):
         i = st[0]
@@ -364,7 +441,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
           jnp.bool_(False))
     st = lax.while_loop(cond, body, st)
     (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
-    return Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl[:-1], mpr[:-1], overflow
+    return (Hb[:R], E1b[:R], E2b[:R], F1b[:R], F2b[:R],
+            dp_beg[:R], dp_end[:R], mpl[:-1], mpr[:-1], overflow)
 
 
 # --------------------------------------------------------------------------- #
@@ -414,8 +492,12 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
         i, j, *_, err, done = st
         return (i > 0) & (j > 0) & (~err) & (~done)
 
-    def body(st):
+    def body1(st):
         (i, j, cur_op, look_gap, n_ops, ops, n_aln, n_match, err, done) = st
+        # predication for unrolling: sub-steps after the walk has logically
+        # ended (or errored) pass the state through unchanged; all gathers
+        # below are clamp-safe for any (i, j)
+        c = (i > 0) & (j > 0) & (~err) & (~done)
         H_ij = gat(H, i, j)
         s = mat[base_r[i], query_pad[j - 1]]
         is_match = (base_r[i] == query_pad[j - 1]).astype(i32)
@@ -512,8 +594,11 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
         m_sel = m1 | m2_sel
 
         op_code = jnp.where(m_sel, 0, jnp.where(d_sel, 1, 2))
-        ops = ops.at[n_ops, 0].set(jnp.where(no_hit, ops[n_ops, 0], op_code))
-        ops = ops.at[n_ops, 1].set(jnp.where(no_hit, ops[n_ops, 1], i))
+        # masked scatter: an out-of-bounds index drops the write (inactive or
+        # dead-end sub-steps record nothing)
+        wr = jnp.where(c & (~no_hit), n_ops, jnp.int32(max_ops))
+        ops = ops.at[wr, 0].set(op_code)
+        ops = ops.at[wr, 1].set(i)
 
         pre_m = pidx[first_m]
         pre_d = pidx[first_d]
@@ -526,13 +611,18 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
                              jnp.where(d_sel | i_sel | m2_sel, i32(0), look_gap))
         new_naln = n_aln + jnp.where(m_sel | i_sel, 1, 0)
         new_nmatch = n_match + jnp.where(m_sel, is_match, 0)
-        adv = ~no_hit
+        adv = (~no_hit) & c
         cap = n_ops + 1 >= max_ops
         return ((jnp.where(adv, new_i, i)), jnp.where(adv, new_j, j),
                 jnp.where(adv, new_op, cur_op), jnp.where(adv, new_look, look_gap),
                 n_ops + jnp.where(adv, 1, 0), ops,
                 jnp.where(adv, new_naln, n_aln), jnp.where(adv, new_nmatch, n_match),
-                err | no_hit | cap, done)
+                err | (c & (no_hit | cap)), done)
+
+    def body(st):
+        for _ in range(BT_UNROLL):
+            st = body1(st)
+        return st
 
     ops0 = jnp.zeros((max_ops, 2), jnp.int32)
     st0 = (best_i, best_j, i32(C.ALL_OP),
@@ -869,12 +959,14 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
     return FusedState(g=g2, order=order, n2i=n2i, remain=remain_by_node,
                       read_idx=state.read_idx + 1, err=state.err,
                       kahn_runs=state.kahn_runs, paths=paths,
-                      path_lens=path_lens, collisions=state.collisions)
+                      path_lens=path_lens, collisions=state.collisions,
+                      rc_flags=state.rc_flags)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
-    "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths"))
+    "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths",
+    "amb_strand"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
@@ -883,7 +975,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     plane16: bool = False, max_mat: int = 0,
                     int16_limit: int = 0, use_pallas: bool = False,
                     pl_interpret: bool = False,
-                    record_paths: bool = False) -> FusedState:
+                    record_paths: bool = False,
+                    amb_strand: bool = False) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -923,96 +1016,174 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             remain_end = remain[C.SINK_NODE_ID]
             r0 = qlen - (remain_rows[0] - remain_end - 1)
             dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w)
-            qp = qp_mat[k]          # (m, Qp) profile of read k
-
-            def dp_scan_path(_):
-                return _dp_banded(
-                    base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
-                    remain_rows, mpl0, mpr0, qp, n,
-                    qlen, w, remain_end, inf_min, dp_end0,
-                    o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
-                    plane16=plane16)
-
-            if use_pallas:
-                # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
-                # back in-jit to the XLA scan on ring/band overflow (measured
-                # rate on sim10k graphs: 0.0%, PERF.md)
-                from .pallas_fused import pallas_fused_dp
-                N_, E_ = pre_idx.shape
-                is_src_out = (mpl0 == 1) & (mpr0 == 1) & \
-                    (jnp.arange(N_) > 0)
-                base_packed = base_r | (is_src_out.astype(jnp.int32) << 8)
-                pre_cnt = jnp.sum(pre_msk.astype(jnp.int32), axis=1)
-                out_cnt_r = jnp.sum(out_msk.astype(jnp.int32), axis=1)
-                H0, E10, E20, F10, F20 = _row0_planes(
-                    W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf_min)
-                row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
-                qp_padW = jnp.pad(qp, ((0, 0), (0, W)))
-                sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1, e2, oe2,
-                                n, dp_end0] + [jnp.int32(0)] * 6)
-                (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p) = pallas_fused_dp(
-                    sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
-                    remain_rows, row0H, row0E1, row0E2, qp_padW,
-                    R=N_, W=W, P=E_, O=E_, interpret=pl_interpret)
-                # the kernel writes rows 1..: patch the source row in
-                end_p = end_p.at[0].set(dp_end0)
-                beg_p = beg_p.at[0].set(0)
-
-                def take_pl(_):
-                    zeros = jnp.zeros(N_, jnp.int32)
-                    return (Hp.at[0].set(H0), E1p.at[0].set(E10),
-                            E2p.at[0].set(E20), F1p.at[0].set(F10),
-                            F2p.at[0].set(F20), beg_p, end_p,
-                            zeros, zeros, jnp.bool_(False))
-
-                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                 overflow) = lax.cond(ok_p[0] == 1, take_pl, dp_scan_path, None)
-            else:
-                (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                 overflow) = dp_scan_path(None)
-
-            # global best over the sink's predecessor rows at their band ends
-            sink_rows = pre_idx[n - 1]
-            sink_msk = pre_msk[n - 1]
-            ends = jnp.minimum(qlen, dp_end[sink_rows])
-            kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
-            vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
-                             & (ends - dp_beg[sink_rows] < W),
-                             jnp.take_along_axis(Hb[sink_rows], kidx[:, None],
-                                                 axis=1)[:, 0],
-                             inf_min)
-            kk = jnp.argmax(vals)
-            best_i = sink_rows[kk]
-            best_j = ends[kk]
-
-            ops, n_ops, fin_i, fin_j, n_aln, n_match, bt_err = _backtrack_w(
-                Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
-                base_r, query, mat, best_i, best_j,
-                e1, oe1, e2, oe2, inf_min,
-                gap_mode=gap_mode, gap_on_right=gap_on_right,
-                put_gap_at_end=put_gap_at_end, max_ops=max_ops)
-
-            # reverse into forward order (+ head/tail INS for unaligned ends)
             tt = jnp.arange(max_ops, dtype=jnp.int32)
-            head = fin_j
-            mid = head + n_ops
-            n_fwd = mid + (qlen - best_j)
-            src = jnp.clip(n_ops - 1 - (tt - head), 0, max_ops - 1)
-            in_mid = (tt >= head) & (tt < mid)
-            fwd_op = jnp.where(in_mid, ops[src, 0], 2)
-            fwd_arg = jnp.where(in_mid,
-                                order[jnp.clip(ops[src, 1], 0, N - 1)], 0)
-            ops_cap = n_fwd > max_ops
+
+            def align_strand(query_s, qp_s):
+                """Banded DP + device backtrack + forward-op assembly for one
+                strand of the read against the current graph tables. Returns
+                (fwd_op, fwd_arg, n_fwd, best_score, overflow, bt_err,
+                ops_cap)."""
+                def dp_scan_path(_):
+                    return _dp_banded(
+                        base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                        remain_rows, mpl0, mpr0, qp_s, n,
+                        qlen, w, remain_end, inf_min, dp_end0,
+                        o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
+                        plane16=plane16)
+
+                if use_pallas:
+                    # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
+                    # back in-jit to the XLA scan on ring/band overflow
+                    # (measured rate on sim10k graphs: 0.0%, PERF.md). Covers
+                    # all three gap regimes and both plane widths.
+                    from .pallas_fused import pallas_fused_dp
+                    dtp = jnp.int16 if plane16 else jnp.int32
+                    N_, E_ = pre_idx.shape
+                    is_src_out = (mpl0 == 1) & (mpr0 == 1) & \
+                        (jnp.arange(N_) > 0)
+                    base_packed = base_r | (is_src_out.astype(jnp.int32) << 8)
+                    pre_cnt = jnp.sum(pre_msk.astype(jnp.int32), axis=1)
+                    out_cnt_r = jnp.sum(out_msk.astype(jnp.int32), axis=1)
+                    infp = inf_min.astype(dtp)
+                    H0, E10, E20, F10, F20 = _row0_planes(
+                        W, dp_end0, o1.astype(dtp), e1.astype(dtp),
+                        oe1.astype(dtp), o2.astype(dtp), e2.astype(dtp),
+                        oe2.astype(dtp), infp, gap_mode=gap_mode)
+                    row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
+                    qp_padW = jnp.pad(qp_s, ((0, 0), (0, W))).astype(dtp)
+                    sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1,
+                                    e2, oe2, n, dp_end0] + [jnp.int32(0)] * 6)
+                    (Hp, E1p, E2p, F1p, F2p, beg_p, end_p,
+                     ok_p) = pallas_fused_dp(
+                        sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
+                        remain_rows, row0H, row0E1, row0E2, qp_padW,
+                        R=N_, W=W, P=E_, O=E_, gap_mode=gap_mode,
+                        plane16=plane16, interpret=pl_interpret)
+                    # the kernel writes rows 1..: patch the source row in
+                    end_p = end_p.at[0].set(dp_end0)
+                    beg_p = beg_p.at[0].set(0)
+
+                    def take_pl(_):
+                        zeros = jnp.zeros(N_, jnp.int32)
+                        return (Hp.at[0].set(H0), E1p.at[0].set(E10),
+                                E2p.at[0].set(E20), F1p.at[0].set(F10),
+                                F2p.at[0].set(F20), beg_p, end_p,
+                                zeros, zeros, jnp.bool_(False))
+
+                    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                     overflow) = lax.cond(ok_p[0] == 1, take_pl,
+                                          dp_scan_path, None)
+                else:
+                    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                     overflow) = dp_scan_path(None)
+
+                # global best over the sink's pred rows at their band ends
+                sink_rows = pre_idx[n - 1]
+                sink_msk = pre_msk[n - 1]
+                ends = jnp.minimum(qlen, dp_end[sink_rows])
+                kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
+                vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
+                                 & (ends - dp_beg[sink_rows] < W),
+                                 jnp.take_along_axis(Hb[sink_rows],
+                                                     kidx[:, None],
+                                                     axis=1)[:, 0],
+                                 inf_min)
+                kk = jnp.argmax(vals)
+                best_i = sink_rows[kk]
+                best_j = ends[kk]
+                best_sc = vals[kk].astype(jnp.int32)
+
+                ops, n_ops, fin_i, fin_j, n_aln, n_match, bt_err = _backtrack_w(
+                    Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
+                    base_r, query_s, mat, best_i, best_j,
+                    e1, oe1, e2, oe2, inf_min,
+                    gap_mode=gap_mode, gap_on_right=gap_on_right,
+                    put_gap_at_end=put_gap_at_end, max_ops=max_ops)
+
+                # reverse into forward order (+ head/tail INS for the ends)
+                head = fin_j
+                mid = head + n_ops
+                n_fwd = mid + (qlen - best_j)
+                src = jnp.clip(n_ops - 1 - (tt - head), 0, max_ops - 1)
+                in_mid = (tt >= head) & (tt < mid)
+                fwd_op = jnp.where(in_mid, ops[src, 0], 2)
+                fwd_arg = jnp.where(in_mid,
+                                    order[jnp.clip(ops[src, 1], 0, N - 1)], 0)
+                ops_cap = n_fwd > max_ops
+                return (fwd_op, fwd_arg, n_fwd, best_sc, overflow, bt_err,
+                        ops_cap)
+
+            (fwd_op, fwd_arg, n_fwd, best_sc, overflow, bt_err,
+             ops_cap) = align_strand(query, qp_mat[k])
+            if amb_strand:
+                # in-loop ambiguous-strand rescue (src/abpoa_align.c:324-345):
+                # when the forward score is below min(qlen, n-2)*max_mat*
+                # 0.3333, align the reverse complement in the same dispatch
+                # and keep the better strand. The threshold compare is done
+                # in exact integers — proven equal to the reference's double
+                # arithmetic for every realistic operand (PERF.md).
+                Kthr = jnp.minimum(qlen, n - 2) * jnp.int32(max_mat)
+
+                def mul_lt(a, am, b, bm):
+                    # exact a*am < b*bm for 0 <= a,b < 2^31 and small
+                    # multipliers, via 16-bit limbs (the straight int32
+                    # products overflow past ~214k-base reads)
+                    def limbs(x, m):
+                        lo = (x & 0xffff) * m
+                        hi = (x >> 16) * m + (lo >> 16)
+                        return hi, lo & 0xffff
+                    ah, al = limbs(a, am)
+                    bh, bl = limbs(b, bm)
+                    return (ah < bh) | ((ah == bh) & (al < bl))
+
+                need_rc = (best_sc < 0) | mul_lt(jnp.maximum(best_sc, 0),
+                                                 10000, Kthr, 3333)
+                cols = jnp.arange(Qp, dtype=jnp.int32)
+                ridx = jnp.clip(qlen - 1 - cols, 0, Qp - 1)
+                okq = cols < qlen
+                rb = query[ridx]
+                rc_query = jnp.where(okq, jnp.where(rb < 4, 3 - rb, 4), 0)
+                rc_weight = jnp.where(okq, weight[ridx], 1)
+                qsrc = jnp.clip(cols - 1, 0, Qp - 1)
+                rc_qp = jnp.where((cols >= 1) & (cols <= qlen),
+                                  mat[:, rc_query[qsrc]], 0)
+
+                def rc_path(_):
+                    return align_strand(rc_query, rc_qp)
+
+                def no_rc(_):
+                    return (jnp.zeros(max_ops, jnp.int32),
+                            jnp.zeros(max_ops, jnp.int32),
+                            jnp.int32(0), jnp.int32(-(2**30)),
+                            jnp.bool_(False), jnp.bool_(False),
+                            jnp.bool_(False))
+
+                (r_op, r_arg, r_nfwd, r_sc, r_ovf, r_bt,
+                 r_cap) = lax.cond(need_rc, rc_path, no_rc, None)
+                use_rc = need_rc & (r_sc > best_sc)
+                fwd_op = jnp.where(use_rc, r_op, fwd_op)
+                fwd_arg = jnp.where(use_rc, r_arg, fwd_arg)
+                n_fwd = jnp.where(use_rc, r_nfwd, n_fwd)
+                overflow = overflow | r_ovf
+                bt_err = bt_err | r_bt
+                ops_cap = ops_cap | r_cap
+                query_u = jnp.where(use_rc, rc_query, query)
+                weight_u = jnp.where(use_rc, rc_weight, weight)
+            else:
+                use_rc = jnp.bool_(False)
+                query_u = query
+                weight_u = weight
 
             old_n = n
 
             g2, path_nodes, path_len, path_new, collision, edge_cap, grp_full = \
-                _fuse_vectorized(g, fwd_op, fwd_arg, n_fwd, query, qlen, weight)
+                _fuse_vectorized(g, fwd_op, fwd_arg, n_fwd, query_u, qlen,
+                                 weight_u)
 
             def seq_fuse(_):
                 fwd = jnp.stack([jnp.where(tt < n_fwd, fwd_op, 0),
                                  jnp.where(tt < n_fwd, fwd_arg, 0)], axis=1)
-                gs = fuse_alignment(g, fwd, n_fwd, query, qlen, weight,
+                gs = fuse_alignment(g, fwd, n_fwd, query_u, qlen, weight_u,
                                     C.SRC_NODE_ID, C.SINK_NODE_ID,
                                     max_ops=max_ops)
                 return gs
@@ -1076,6 +1247,10 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     jnp.where(keep, st.path_lens[st.read_idx], path_len))
             else:
                 paths, path_lens = st.paths, st.path_lens
+            rc_tgt = jnp.where(keep, jnp.int32(st.rc_flags.shape[0]),
+                               st.read_idx)
+            rc_flags = st.rc_flags.at[rc_tgt].set(
+                use_rc.astype(jnp.int32))  # OOB scatter drops (dummy buffer)
             return FusedState(
                 g=g_out,
                 order=jnp.where(keep, order, order3),
@@ -1085,7 +1260,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                 err=err,
                 kahn_runs=st.kahn_runs + jnp.where(~keep & need_kahn, 1, 0),
                 paths=paths, path_lens=path_lens,
-                collisions=st.collisions + jnp.where(~keep & collision, 1, 0))
+                collisions=st.collisions + jnp.where(~keep & collision, 1, 0),
+                rc_flags=rc_flags)
 
         return lax.cond(st.g.node_n == 2, seed, align_and_fuse, st)
 
@@ -1124,7 +1300,7 @@ def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
         remain=grow1(state.remain), read_idx=state.read_idx,
         err=jnp.int32(ERR_OK), kahn_runs=state.kahn_runs,
         paths=state.paths, path_lens=state.path_lens,
-        collisions=state.collisions)
+        collisions=state.collisions, rc_flags=state.rc_flags)
 
 
 def fused_eligible(abpt: Params, n_seq: int) -> bool:
@@ -1135,27 +1311,101 @@ def fused_eligible(abpt: Params, n_seq: int) -> bool:
             and not abpt.inc_path_score
             and abpt.zdrop <= 0
             and not (abpt.use_qv and abpt.max_n_cons > 1)
-            and not abpt.amb_strand
-            and not abpt.incr_fn
+            and not (abpt.incr_fn and abpt.use_read_ids)
             and abpt.ret_cigar
             and n_seq >= 2)
+
+
+def _state_from_host_graph(pg, abpt: Params, N: int, E: int, A: int,
+                           n_reads: int, Pcap: int, n_rc: int) -> FusedState:
+    """Upload a restored host graph as the fused loop's starting state
+    (incremental MSA `-i`, reference abpoa_restore_graph
+    src/abpoa_seq.c:608-673). The host graph must be topologically sorted:
+    its reference BFS order is a valid topo order for the loop, its edge
+    slots are weight-sorted, and max_remain comes along unchanged."""
+    n = pg.node_n
+    base = np.zeros(N, np.int32)
+    in_ids = np.zeros((N, E), np.int32)
+    in_w = np.zeros((N, E), np.int32)
+    in_cnt = np.zeros(N, np.int32)
+    out_ids = np.zeros((N, E), np.int32)
+    out_w = np.zeros((N, E), np.int32)
+    out_cnt = np.zeros(N, np.int32)
+    aligned = np.zeros((N, A), np.int32)
+    aligned_cnt = np.zeros(N, np.int32)
+    n_read = np.zeros(N, np.int32)
+    n_span = np.zeros(N, np.int32)
+    for i in range(n):
+        nd = pg.nodes[i]
+        base[i] = nd.base
+        ic, oc, ac = len(nd.in_ids), len(nd.out_ids), len(nd.aligned_ids)
+        in_ids[i, :ic] = nd.in_ids
+        in_w[i, :ic] = nd.in_w
+        in_cnt[i] = ic
+        out_ids[i, :oc] = nd.out_ids
+        out_w[i, :oc] = nd.out_w
+        out_cnt[i] = oc
+        aligned[i, :ac] = nd.aligned_ids
+        aligned_cnt[i] = ac
+        n_read[i] = nd.n_read
+        n_span[i] = nd.n_span_read
+    order = np.zeros(N, np.int32)
+    order[:n] = pg.index_to_node_id[:n]
+    n2i = np.zeros(N, np.int32)
+    n2i[order[:n]] = np.arange(n, dtype=np.int32)
+    remain = np.zeros(N, np.int32)
+    remain[:n] = pg.node_id_to_max_remain[:n]
+    g = DeviceGraph(
+        base=jnp.asarray(base),
+        in_ids=jnp.asarray(in_ids), in_w=jnp.asarray(in_w),
+        in_cnt=jnp.asarray(in_cnt),
+        out_ids=jnp.asarray(out_ids), out_w=jnp.asarray(out_w),
+        out_cnt=jnp.asarray(out_cnt),
+        aligned=jnp.asarray(aligned), aligned_cnt=jnp.asarray(aligned_cnt),
+        n_read=jnp.asarray(n_read), n_span=jnp.asarray(n_span),
+        node_n=jnp.int32(n), ok=jnp.bool_(True))
+    return FusedState(
+        g=g, order=jnp.asarray(order), n2i=jnp.asarray(n2i),
+        remain=jnp.asarray(remain),
+        read_idx=jnp.int32(0), err=jnp.int32(ERR_OK),
+        kahn_runs=jnp.int32(0),
+        paths=jnp.zeros((n_reads, Pcap), jnp.int32),
+        path_lens=jnp.zeros(n_reads, jnp.int32),
+        collisions=jnp.int32(0),
+        rc_flags=jnp.zeros(max(n_rc, 1), jnp.int32))
 
 
 def progressive_poa_fused(seqs: List[np.ndarray],
                           weights: List[np.ndarray],
                           abpt: Params,
                           max_chunks: int = 24,
-                          use_pallas: bool = None):
+                          use_pallas: bool = None,
+                          init_graph=None):
     """Run the fused loop over a read set; returns a host POAGraph ready for
-    consensus/output (reference abpoa_poa, src/abpoa_align.c:313-353)."""
+    consensus/output (reference abpoa_poa, src/abpoa_align.c:313-353).
+
+    init_graph: a restored host POAGraph to extend (incremental MSA `-i`);
+    None starts from the empty graph."""
     n_reads = len(seqs)
     qmax = max(len(s) for s in seqs)
     Qp = _bucket(qmax + 2, 128)
     w_full = abpt.wb + int(abpt.wf * qmax)
     W = max(128, _bucket_pow2(2 * w_full + 4))
-    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    n0 = 0
     E = 8
     A = 8
+    if init_graph is not None and init_graph.node_n > 2:
+        if not init_graph.is_topological_sorted:
+            init_graph.topological_sort(abpt)
+        n0 = init_graph.node_n
+        maxdeg = max(max(len(nd.in_ids), len(nd.out_ids))
+                     for nd in init_graph.nodes[:n0])
+        maxaln = max(len(nd.aligned_ids) for nd in init_graph.nodes[:n0])
+        E = max(E, _bucket_pow2(maxdeg + 1))
+        A = max(A, _bucket_pow2(maxaln + 1))
+    else:
+        init_graph = None
+    N = _bucket(n0 + 2 * (qmax + 2) + 64, 1024)
 
     seqs_pad = np.zeros((n_reads, Qp), dtype=np.int32)
     wgts_pad = np.ones((n_reads, Qp), dtype=np.int32)
@@ -1181,13 +1431,27 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     int16_limit = int16_score_limit(abpt)
     plane16 = max_score_bound(abpt, qmax, 2) <= int16_limit
     if use_pallas is None:
-        use_pallas = abpt.device == "pallas" and abpt.gap_mode == C.CONVEX_GAP
+        use_pallas = abpt.device == "pallas"
     pl_interpret = jax.default_backend() != "tpu"
 
     record_paths = bool(abpt.use_read_ids)
-    state = init_fused_state(N, E, A,
-                             n_reads=n_reads if record_paths else 1,
-                             Pcap=Qp + 2 if record_paths else 8)
+    amb = bool(abpt.amb_strand)
+    if init_graph is not None and record_paths:
+        # replayed bitsets cannot reconstruct the restored reads' edge sets
+        raise RuntimeError(
+            "fused loop: incremental restore with read-id outputs "
+            "needs the host loop")
+    if init_graph is not None:
+        state = _state_from_host_graph(
+            init_graph, abpt, N, E, A,
+            n_reads=n_reads if record_paths else 1,
+            Pcap=Qp + 2 if record_paths else 8,
+            n_rc=n_reads if amb else 1)
+    else:
+        state = init_fused_state(N, E, A,
+                                 n_reads=n_reads if record_paths else 1,
+                                 Pcap=Qp + 2 if record_paths else 8,
+                                 n_rc=n_reads if amb else 1)
     kahn_total = 0
     for _ in range(max_chunks):
         max_ops = N + Qp + 8
@@ -1204,8 +1468,9 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             put_gap_at_end=bool(abpt.put_gap_at_end),
             plane16=plane16, max_mat=int(abpt.max_mat),
             int16_limit=int(int16_limit),
-            use_pallas=bool(use_pallas) and not plane16,
-            pl_interpret=pl_interpret, record_paths=record_paths)
+            use_pallas=bool(use_pallas),
+            pl_interpret=pl_interpret, record_paths=record_paths,
+            amb_strand=amb)
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:
@@ -1251,7 +1516,9 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     pg = _download_graph(state, abpt)
     if abpt.use_read_ids:
         _replay_read_ids(pg, state, n_reads)
-    return pg, kahn_total
+    is_rc = ([bool(x) for x in np.asarray(state.rc_flags)[:n_reads]]
+             if amb else [False] * n_reads)
+    return pg, kahn_total, is_rc
 
 
 def _replay_read_ids(pg, state: FusedState, n_reads: int) -> None:

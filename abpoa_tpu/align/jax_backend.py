@@ -536,9 +536,42 @@ def _dp_full_batch(arrays, scalars, inf_min, scores, zdrop, **statics):
                                   {k: 0 for k in _SCALAR_KEYS}))(arrays, scalars)
 
 
+def _window_mesh_size(B: int) -> int:
+    """Largest power-of-two device count that divides the (power-of-two)
+    window batch; 1 disables sharding (single chip / single window)."""
+    try:
+        n_avail = len(jax.devices())
+    except Exception:
+        return 1
+    n = 1
+    while n * 2 <= min(n_avail, B):
+        n *= 2
+    return n
+
+
+def _dp_full_batch_sharded(arrays, scalars, inf_min, scores, zdrop,
+                           n_dev: int, **statics):
+    """Shard the window batch over an n_dev-device mesh.
+
+    Seeded windows are independent alignments against the same frozen graph
+    (reference src/abpoa_align.c:268-290), so the batch splits across chips
+    with no collectives — this is the v5e-8 scaling axis for one read set
+    (`-S` mode): all 8 chips work on one read's windows at once.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as _np
+    mesh = Mesh(_np.array(jax.devices()[:n_dev]), ("w",))
+    fn = functools.partial(_dp_full_batch, **statics)
+    sharded = jax.shard_map(fn, mesh=mesh,
+                            in_specs=(P("w"), P("w"), P(), P(), P()),
+                            out_specs=P("w"), check_vma=False)
+    return sharded(arrays, scalars, inf_min, scores, zdrop)
+
+
 def align_windows_jax(g: POAGraph, abpt: Params,
                       windows) -> list:
-    """Align a batch of independent subgraph windows in ONE device dispatch.
+    """Align a batch of independent subgraph windows in ONE device dispatch,
+    sharded across all available devices when more than one is attached.
 
     windows: list of (beg_node_id, end_node_id, query) tuples. Returns one
     AlignResult per window, byte-identical to aligning them sequentially.
@@ -566,17 +599,22 @@ def align_windows_jax(g: POAGraph, abpt: Params,
     extend = abpt.align_mode == C.EXTEND_MODE
     zdrop_on = extend and abpt.zdrop > 0
 
-    packed = _dp_full_batch(
-        arrays, scalars, jnp.int32(inf_min),
-        (jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
-         jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
-         jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2)),
-        jnp.int32(max(abpt.zdrop, 0)),
+    statics = dict(
         gap_mode=abpt.gap_mode, local=abpt.align_mode == C.LOCAL_MODE,
         banded=abpt.wb >= 0, n_steps=R - 1, align_mode=abpt.align_mode,
         gap_on_right=bool(abpt.put_gap_on_right),
         put_gap_at_end=bool(abpt.put_gap_at_end), max_ops=max_ops,
         ret_cigar=bool(abpt.ret_cigar), zdrop_on=zdrop_on)
+    args = (arrays, scalars, jnp.int32(inf_min),
+            (jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+             jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+             jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2)),
+            jnp.int32(max(abpt.zdrop, 0)))
+    n_dev = _window_mesh_size(len(padded))
+    if n_dev > 1:
+        packed = _dp_full_batch_sharded(*args, n_dev=n_dev, **statics)
+    else:
+        packed = _dp_full_batch(*args, **statics)
     packed = np.asarray(packed)  # ONE device->host transfer for all windows
     return [_result_from_packed(g, abpt, packed[i], snaps[i], R, max_ops)
             for i in range(len(snaps))]

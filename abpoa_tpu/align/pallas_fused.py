@@ -12,12 +12,14 @@ Differences from pallas_kernel.py (the per-alignment prototype):
   them); mpl/mpr are NOT output — the fused loop rebuilds adaptive-band
   state from the graph each read, matching the reference's re-init in
   abpoa_topological_sort;
-- convex-gap global banded, int32 planes (the post-promotion regime that
-  covers the bulk of 10 kb-scale work; int16 chunks use the XLA scan).
+- covers all three gap regimes (linear/affine/convex, global banded) and
+  both plane widths (int16 while the reference promotion bound allows,
+  int32 after — /root/reference/src/abpoa_align_simd.c:1293-1302). int16
+  planes double the effective VPU lanes exactly where most reads live.
 
 Semantics are identical to fused_loop._dp_banded row for row; reference:
-/root/reference/src/abpoa_align_simd.c:935-1074 (cg kernel), band macros
-src/abpoa_align.h:34-35.
+/root/reference/src/abpoa_align_simd.c:727-1074 (lg/ag/cg kernels), band
+macros src/abpoa_align.h:34-35.
 """
 from __future__ import annotations
 
@@ -29,30 +31,43 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import constants as C
+
 # ring capacity (rows) for predecessor windows and band scalars
 RING_D = 512
 
 
-def _make_kernel(W: int, P: int, O: int, D: int):
+def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+    dt = jnp.int16 if plane16 else jnp.int32
+
     def kernel(sc_ref, base_ref, pre_idx_ref, pre_cnt_ref, out_idx_ref,
                out_cnt_ref, remain_ref, row0H_ref, row0E1_ref, row0E2_ref,
                qp_ref,
                H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
-               ok_out,
-               ringH, ringE1, ringE2, beg_s, end_s, mpl_s, mpr_s, ok_s):
+               ok_out, *scratch):
+        if convex:
+            (ringH, ringE1, ringE2, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+        elif linear:
+            (ringH, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+            ringE1 = ringE2 = None
+        else:
+            (ringH, ringE1, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+            ringE2 = None
         i = pl.program_id(0)
         n_steps = pl.num_programs(0)
         qlen = sc_ref[0]
         w = sc_ref[1]
         remain_end = sc_ref[2]
-        inf = sc_ref[3]
-        e1, oe1 = sc_ref[4], sc_ref[5]
-        e2, oe2 = sc_ref[6], sc_ref[7]
+        inf = sc_ref[3].astype(dt)
+        e1, oe1 = sc_ref[4].astype(dt), sc_ref[5].astype(dt)
+        e2, oe2 = sc_ref[6].astype(dt), sc_ref[7].astype(dt)
         gn = sc_ref[8]
         end0 = sc_ref[9]
 
         col = lax.broadcasted_iota(jnp.int32, (1, W), 1)
-        neg_row = jnp.full((1, W), inf, jnp.int32)
+        neg_row = jnp.full((1, W), inf, dt)
 
         @pl.when(i == 0)
         def _init():
@@ -70,8 +85,10 @@ def _make_kernel(W: int, P: int, O: int, D: int):
             beg_s[0] = 0
             end_s[0] = end0
             ringH[0, :] = row0H_ref[0, :]
-            ringE1[0, :] = row0E1_ref[0, :]
-            ringE2[0, :] = row0E2_ref[0, :]
+            if not linear:
+                ringE1[0, :] = row0E1_ref[0, :]
+            if convex:
+                ringE2[0, :] = row0E2_ref[0, :]
 
         row = i + 1
         active = (row < gn - 1) & (ok_s[0] == 1)
@@ -139,11 +156,18 @@ def _make_kernel(W: int, P: int, O: int, D: int):
                 hs = gather(ringH, p, beg - 1 - pbeg)
                 hs = jnp.where((cols - 1 >= pbeg) & (cols - 1 <= pend), hs, inf)
                 Mq = jnp.maximum(Mq, hs)
-                e1s = gather(ringE1, p, beg - pbeg)
-                e2s = gather(ringE2, p, beg - pbeg)
                 eok = (cols >= pbeg) & (cols <= pend)
-                E1r = jnp.maximum(E1r, jnp.where(eok, e1s, inf))
-                E2r = jnp.maximum(E2r, jnp.where(eok, e2s, inf))
+                if linear:
+                    # E contribution reads the predecessor H plane directly
+                    # (lg regime: no E plane exists)
+                    hj = gather(ringH, p, beg - pbeg)
+                    E1r = jnp.maximum(E1r, jnp.where(eok, hj, inf))
+                else:
+                    e1s = gather(ringE1, p, beg - pbeg)
+                    E1r = jnp.maximum(E1r, jnp.where(eok, e1s, inf))
+                    if convex:
+                        e2s = gather(ringE2, p, beg - pbeg)
+                        E2r = jnp.maximum(E2r, jnp.where(eok, e2s, inf))
                 return (Mq, E1r, E2r)
 
             Mq, E1r, E2r = lax.fori_loop(
@@ -151,9 +175,6 @@ def _make_kernel(W: int, P: int, O: int, D: int):
 
             qprow = qp_ref[pl.ds(base_v, 1), pl.ds(beg, W)]
             Mq = jnp.where(in_band, Mq + qprow, inf)
-            E1r = jnp.where(in_band, E1r, inf)
-            E2r = jnp.where(in_band, E2r, inf)
-            Hhat = jnp.maximum(jnp.maximum(Mq, E1r), E2r)
 
             def chain(A, ext):
                 F = A
@@ -166,23 +187,51 @@ def _make_kernel(W: int, P: int, O: int, D: int):
                     shift <<= 1
                 return F
 
-            Hm1 = jnp.where(col >= 1, pltpu.roll(Hhat, 1, axis=1), inf)
-            A1 = jnp.where(in_band, jnp.where(col == 0, Mq - oe1, Hm1 - oe1), inf)
-            A2 = jnp.where(in_band, jnp.where(col == 0, Mq - oe2, Hm1 - oe2), inf)
-            F1 = chain(A1, e1)
-            F2 = chain(A2, e2)
-            Hrow = jnp.maximum(Hhat, jnp.maximum(F1, F2))
-            E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-            E2n = jnp.maximum(E2r - e2, Hrow - oe2)
-            Hrow = jnp.where(in_band, Hrow, inf)
-            E1n = jnp.where(in_band, E1n, inf)
-            E2n = jnp.where(in_band, E2n, inf)
-            F1 = jnp.where(in_band, F1, inf)
-            F2 = jnp.where(in_band, F2, inf)
+            if linear:
+                # lg regime: Erow = max over preds of H[pre][j] - e1; H row is
+                # an in-row gap chain over max(M, E) (fused_loop._dp_banded
+                # linear branch; reference simd_abpoa_lg_dp :727-815)
+                Erow = jnp.where(in_band, E1r - e1, inf)
+                Hhat = jnp.maximum(Mq, Erow)
+                Hrow = jnp.where(in_band, chain(Hhat, e1), inf)
+                E1n = E2n = F1 = F2 = neg_row
+            else:
+                E1r = jnp.where(in_band, E1r, inf)
+                Hhat = jnp.maximum(Mq, E1r)
+                if convex:
+                    E2r = jnp.where(in_band, E2r, inf)
+                    Hhat = jnp.maximum(Hhat, E2r)
+                Hm1 = jnp.where(col >= 1, pltpu.roll(Hhat, 1, axis=1), inf)
+                A1 = jnp.where(in_band,
+                               jnp.where(col == 0, Mq - oe1, Hm1 - oe1), inf)
+                F1 = chain(A1, e1)
+                Hrow = jnp.maximum(Hhat, F1)
+                if convex:
+                    A2 = jnp.where(in_band,
+                                   jnp.where(col == 0, Mq - oe2, Hm1 - oe2),
+                                   inf)
+                    F2 = chain(A2, e2)
+                    Hrow = jnp.maximum(Hrow, F2)
+                    E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+                    E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+                else:
+                    F2 = neg_row
+                    # ag regime gates E on H == Hhat (reference
+                    # simd_abpoa_ag_dp :817-933; _dp_banded affine branch)
+                    E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+                    E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                    E2n = neg_row
+                Hrow = jnp.where(in_band, Hrow, inf)
+                E1n = jnp.where(in_band, E1n, inf)
+                E2n = jnp.where(in_band, E2n, inf)
+                F1 = jnp.where(in_band, F1, inf)
+                F2 = jnp.where(in_band, F2, inf)
 
             ringH[row % D, :] = Hrow[0]
-            ringE1[row % D, :] = E1n[0]
-            ringE2[row % D, :] = E2n[0]
+            if not linear:
+                ringE1[row % D, :] = E1n[0]
+            if convex:
+                ringE2[row % D, :] = E2n[0]
             H_out[0, :] = Hrow[0]
             E1_out[0, :] = E1n[0]
             E2_out[0, :] = E2n[0]
@@ -229,22 +278,31 @@ def _make_kernel(W: int, P: int, O: int, D: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("R", "W", "P", "O", "interpret"))
+@functools.partial(jax.jit, static_argnames=(
+    "R", "W", "P", "O", "gap_mode", "plane16", "interpret"))
 def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
                     remain_rows, row0H, row0E1, row0E2, qp_pad,
-                    R: int, W: int, P: int, O: int, interpret: bool = False):
-    """Banded convex-global forward DP for the fused loop.
+                    R: int, W: int, P: int, O: int,
+                    gap_mode: int = C.CONVEX_GAP, plane16: bool = False,
+                    interpret: bool = False):
+    """Banded global forward DP for the fused loop (all gap regimes).
 
-    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W).
-    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok) with (R, W) planes.
+    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) in the
+    plane dtype. row0*: (1, W) plane dtype. scalars: (16,) int32.
+    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok); planes are (R, W) in the
+    plane dtype (int16 when plane16). Unused planes for the lighter regimes
+    are -inf filled, matching _dp_banded.
     """
     D = RING_D
-    kernel = _make_kernel(W, P, O, D)
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+    dt = jnp.int16 if plane16 else jnp.int32
+    kernel = _make_kernel(W, P, O, D, gap_mode, plane16)
     m = qp_pad.shape[0]
     row_i32 = lambda width: pl.BlockSpec((1, width), lambda i: (i + 1, 0),
                                          memory_space=pltpu.SMEM)
     out_shapes = (
-        [jax.ShapeDtypeStruct((R, W), jnp.int32)] * 5
+        [jax.ShapeDtypeStruct((R, W), dt)] * 5
         + [jax.ShapeDtypeStruct((R,), jnp.int32),
            jax.ShapeDtypeStruct((R,), jnp.int32),
            jax.ShapeDtypeStruct((1,), jnp.int32)])
@@ -267,10 +325,12 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         pl.BlockSpec((m, qp_pad.shape[1]), lambda i: (0, 0),
                      memory_space=pltpu.VMEM),
     ]
-    scratch = [
-        pltpu.VMEM((D, W), jnp.int32),
-        pltpu.VMEM((D, W), jnp.int32),
-        pltpu.VMEM((D, W), jnp.int32),
+    rings = [pltpu.VMEM((D, W), dt)]            # H ring
+    if not linear:
+        rings.append(pltpu.VMEM((D, W), dt))    # E1 ring
+    if convex:
+        rings.append(pltpu.VMEM((D, W), dt))    # E2 ring
+    scratch = rings + [
         pltpu.SMEM((D,), jnp.int32),   # beg ring
         pltpu.SMEM((D,), jnp.int32),   # end ring
         pltpu.SMEM((D,), jnp.int32),   # mpl ring

@@ -80,7 +80,7 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     """Route the plain progressive loop through the single-dispatch all-device
     path when the device backend is selected and the config is in scope
     (align/fused_loop.py). Returns False to fall back to the per-read loop."""
-    if abpt.device not in ("jax", "tpu", "pallas") or exist_n_seq:
+    if abpt.device not in ("jax", "tpu", "pallas"):
         return False
     from .utils.probe import jax_backend_reachable, warn_unreachable_once
     if not jax_backend_reachable():
@@ -91,13 +91,29 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     from .align.fused_loop import fused_eligible, progressive_poa_fused
     if not fused_eligible(abpt, len(seqs)):
         return False
+    init_graph = None
+    if exist_n_seq:
+        # incremental `-i`: extend the restored graph on device; read-id
+        # outputs still need the host loop (bitset replay cannot cover the
+        # restored reads' edges)
+        if abpt.use_read_ids:
+            return False
+        g = ab.graph
+        if getattr(g, "is_native", False):
+            g = g.to_python(abpt)
+        if g.node_n > 2:
+            init_graph = g
     try:
-        pg, _ = progressive_poa_fused(seqs, weights, abpt)
+        pg, _, is_rc = progressive_poa_fused(seqs, weights, abpt,
+                                             init_graph=init_graph)
     except RuntimeError as e:
         print(f"Warning: fused device loop failed ({e}); "
               "falling back to the per-read loop.", file=sys.stderr)
         return False
     ab.graph = pg
+    if abpt.amb_strand:
+        for i, flag in enumerate(is_rc):
+            ab.is_rc[exist_n_seq + i] = flag
     return True
 
 

@@ -97,7 +97,7 @@ def _time_run_subprocess(device, path, warm, timeout):
 def _run_workload(key, path, n_reads, devices, warm, per_backend, results):
     for device in devices:
         try:
-            if device == "jax":
+            if device in ("jax", "pallas"):
                 wall = _time_run_subprocess(device, path, warm,
                                             _JAX_TIMEOUT.get(key, 900))
             else:
@@ -129,6 +129,7 @@ def main():
         pass
     if _accelerator_reachable():
         devices.append("jax")
+        devices.append("pallas")
 
     per_backend = {}
     results = {}

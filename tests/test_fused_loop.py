@@ -34,7 +34,7 @@ def _consensus_via_fused(path, **kw):
     seqs = [enc[np.frombuffer(r.seq.encode(), dtype=np.uint8)].astype(np.uint8)
             for r in recs]
     wgts = [np.ones(len(s), dtype=np.int64) for s in seqs]
-    pg, kahn = progressive_poa_fused(seqs, wgts, abpt)
+    pg, kahn, _ = progressive_poa_fused(seqs, wgts, abpt)
     cons = generate_consensus(pg, abpt, len(seqs))
     out = io.StringIO()
     output_fx_consensus(cons, abpt, out)
@@ -159,7 +159,7 @@ def test_fused_random_reads_consensus_matches(gap):
     poa(ab, abpt, reads, weights, 0)
     cons_host = generate_consensus(ab.graph, abpt, len(reads)).cons_base
 
-    pg, _ = progressive_poa_fused(reads, weights, abpt)
+    pg, _, _ = progressive_poa_fused(reads, weights, abpt)
     cons_dev = generate_consensus(pg, abpt, len(reads)).cons_base
     assert cons_host == cons_dev
 
@@ -181,8 +181,59 @@ def test_fused_read_id_collision_rate_sim2k():
             for r in recs]
     wgts = [np.ones(len(s), dtype=np.int64) for s in seqs]
     # raises RuntimeError if any collision fallback fired
-    pg, _ = progressive_poa_fused(seqs, wgts, abpt)
+    pg, _, _ = progressive_poa_fused(seqs, wgts, abpt)
     assert pg.node_n > 2
+
+
+@pytest.mark.parametrize("flags", [["-s"], ["-s", "-r1"]])
+def test_fused_amb_strand(flags):
+    """In-loop ambiguous-strand rescue (reference src/abpoa_align.c:324-345):
+    the fused loop aligns the reverse complement in the same dispatch when the
+    forward score is under the threshold and keeps the better strand; output
+    (including per-read is_rc annotations in MSA mode) must byte-match the
+    host loop without falling back."""
+    import subprocess
+    path = os.path.join(DATA_DIR, "rcmix.fa")
+
+    def cli(device):
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import sys, runpy\n"
+            f"sys.argv = ['abpoa', '--device', {device!r}] + {flags!r} + [{path!r}]\n"
+            "runpy.run_module('abpoa_tpu.cli', run_name='__main__')\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "falling back" not in proc.stderr
+        return proc.stdout
+
+    assert cli("jax") == cli("numpy")
+
+
+@pytest.mark.parametrize("restore", ["seq10.gfa", "seq10.msa"])
+def test_fused_incremental_restore(restore):
+    """Incremental MSA `-i` through the fused loop: the restored host graph
+    is uploaded as the device starting state (reference abpoa_restore_graph,
+    src/abpoa_seq.c:608-673) and new reads align/fuse on device; output must
+    byte-match the host loop without falling back."""
+    import subprocess
+    inc = os.path.join(DATA_DIR, restore)
+    path = os.path.join(DATA_DIR, "seq4.fa")
+
+    def cli(device):
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import sys, runpy\n"
+            f"sys.argv = ['abpoa', '--device', {device!r}, '-i', {inc!r}, "
+            f"{path!r}]\n"
+            "runpy.run_module('abpoa_tpu.cli', run_name='__main__')\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "falling back" not in proc.stderr
+        return proc.stdout
+
+    assert cli("jax") == cli("numpy")
 
 
 def test_fused_pipeline_wiring():

@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tests.conftest import DATA_DIR  # noqa: E402
 
 
-def _cons(path, use_pallas):
+def _cons(path, use_pallas, **kw):
     import abpoa_tpu.align.fused_loop as fl
     from abpoa_tpu.params import Params
     from abpoa_tpu.io.fastx import read_fastx
@@ -25,13 +25,15 @@ def _cons(path, use_pallas):
     from abpoa_tpu.io.output import output_fx_consensus
     abpt = Params()
     abpt.device = "pallas"
+    for k, v in kw.items():
+        setattr(abpt, k, v)
     abpt.finalize()
     recs = read_fastx(path)
     enc = abpt.char_to_code
     seqs = [enc[np.frombuffer(r.seq.encode(), dtype=np.uint8)].astype(np.uint8)
             for r in recs]
     wgts = [np.ones(len(s), dtype=np.int64) for s in seqs]
-    pg, _ = fl.progressive_poa_fused(seqs, wgts, abpt, use_pallas=use_pallas)
+    pg, _, _ = fl.progressive_poa_fused(seqs, wgts, abpt, use_pallas=use_pallas)
     cons = generate_consensus(pg, abpt, len(recs))
     out = io.StringIO()
     output_fx_consensus(cons, abpt, out)
@@ -39,12 +41,36 @@ def _cons(path, use_pallas):
 
 
 @pytest.mark.parametrize("fname", ["test.fa", "seq.fa", "heter.fa"])
-def test_pallas_fused_matches_scan(fname, monkeypatch):
-    """The Pallas path only covers int32 chunks; force int32 so it runs."""
+def test_pallas_fused_matches_scan_int32(fname, monkeypatch):
+    """int32 planes (post-promotion regime), convex gap."""
     import abpoa_tpu.align.fused_loop as fl
     monkeypatch.setattr(fl, "int16_score_limit", lambda abpt: -1)
     path = os.path.join(DATA_DIR, fname)
     assert _cons(path, True) == _cons(path, False)
+
+
+@pytest.mark.parametrize("gap_kw", [
+    {},                                  # convex (default)
+    {"gap_open2": 0},                    # affine
+    {"gap_open1": 0, "gap_open2": 0},    # linear
+], ids=["convex", "affine", "linear"])
+def test_pallas_fused_matches_scan_int16(gap_kw):
+    """int16 planes (the natural width for short reads — the reference's
+    preferred regime, abpoa_align_simd.c:1293-1302) across all gap modes."""
+    path = os.path.join(DATA_DIR, "seq.fa")
+    assert _cons(path, True, **gap_kw) == _cons(path, False, **gap_kw)
+
+
+@pytest.mark.parametrize("gap_kw", [
+    {"gap_open2": 0},
+    {"gap_open1": 0, "gap_open2": 0},
+], ids=["affine", "linear"])
+def test_pallas_fused_matches_scan_int32_regimes(gap_kw, monkeypatch):
+    """Affine/linear with int32 planes."""
+    import abpoa_tpu.align.fused_loop as fl
+    monkeypatch.setattr(fl, "int16_score_limit", lambda abpt: -1)
+    path = os.path.join(DATA_DIR, "seq.fa")
+    assert _cons(path, True, **gap_kw) == _cons(path, False, **gap_kw)
 
 
 def _accelerator_reachable():
@@ -81,7 +107,7 @@ def cons(use_pallas):
     seqs = [enc[np.frombuffer(r.seq.encode(), dtype=np.uint8)].astype(np.uint8)
             for r in recs]
     wgts = [np.ones(len(s), dtype=np.int64) for s in seqs]
-    pg, _ = fl.progressive_poa_fused(seqs, wgts, abpt, use_pallas=use_pallas)
+    pg, _, _ = fl.progressive_poa_fused(seqs, wgts, abpt, use_pallas=use_pallas)
     c = generate_consensus(pg, abpt, len(recs))
     out = io.StringIO(); output_fx_consensus(c, abpt, out)
     return out.getvalue()
