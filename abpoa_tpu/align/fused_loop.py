@@ -1050,7 +1050,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         oe1.astype(dtp), o2.astype(dtp), e2.astype(dtp),
                         oe2.astype(dtp), infp, gap_mode=gap_mode)
                     row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
-                    qp_padW = jnp.pad(qp_s, ((0, 0), (0, W))).astype(dtp)
+                    qp_padW = jnp.pad(qp_s, ((0, 0), (0, W)))
                     sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1,
                                     e2, oe2, n, dp_end0] + [jnp.int32(0)] * 6)
                     (Hp, E1p, E2p, F1p, F2p, beg_p, end_p,
@@ -1316,7 +1316,7 @@ def fused_eligible(abpt: Params, n_seq: int) -> bool:
             and n_seq >= 2)
 
 
-def _state_from_host_graph(pg, abpt: Params, N: int, E: int, A: int,
+def _state_from_host_graph(pg, N: int, E: int, A: int,
                            n_reads: int, Pcap: int, n_rc: int) -> FusedState:
     """Upload a restored host graph as the fused loop's starting state
     (incremental MSA `-i`, reference abpoa_restore_graph
@@ -1443,7 +1443,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             "needs the host loop")
     if init_graph is not None:
         state = _state_from_host_graph(
-            init_graph, abpt, N, E, A,
+            init_graph, N, E, A,
             n_reads=n_reads if record_paths else 1,
             Pcap=Qp + 2 if record_paths else 8,
             n_rc=n_reads if amb else 1)

@@ -146,6 +146,12 @@ def align_sequence_to_subgraph_pallas(g: POAGraph, abpt: Params, beg_node_id: in
     D = 64
     Qp = _bucket(qlen + 1, 128)
 
+    # the kernel keeps all per-row tables in SMEM (1 MB/core on v5e): guard
+    # the footprint and fall back to the full-width scan for huge graphs
+    from .pallas_kernel import smem_words
+    if 4 * smem_words(R, P, O, D) > 650_000:
+        return align_sequence_to_subgraph_jax(g, abpt, beg_node_id, end_node_id, query)
+
     # row 0 init (source row), host-side
     r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
     dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)

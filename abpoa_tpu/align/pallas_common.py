@@ -1,0 +1,58 @@
+"""Helpers shared by the two Pallas TPU kernels (pallas_kernel.py,
+pallas_fused.py) so Mosaic workarounds stay in one place and the kernels
+cannot silently diverge."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# rows per streamed VMEM block: divisible by the int32 (8) and int16 (16)
+# sublane tilings, small enough that edge blocks stay cheap on tiny graphs
+BLOCK_B = 32
+
+
+def roll_any(x, shift, axis: int = 1):
+    """pltpu.roll for any integer dtype: Mosaic's rotate is 32-bit only, so
+    narrower vectors round-trip through int32 (lossless)."""
+    if x.dtype == jnp.int32:
+        return pltpu.roll(x, shift, axis=axis)
+    return pltpu.roll(x.astype(jnp.int32), shift, axis=axis).astype(x.dtype)
+
+
+def make_ring_gather(col, neg_row, W: int, D: int):
+    """Band-realignment gather from a (D, W) VMEM ring.
+
+    out[k] = win[k + sh] if 0 <= k + sh < W else -inf, expressed as a dynamic
+    rotate + mask: Mosaic has no value-level dynamic_slice and no dynamic
+    lane starts for VMEM loads, but tpu.dynamic_rotate takes traced shifts.
+    """
+    def gather(ring_ref, p, shift):
+        win = ring_ref[pl.ds(p % D, 1), :]
+        sh = jnp.clip(shift, -W, W)
+        rolled = pltpu.roll(win, jnp.mod(-sh, W), axis=1)
+        okc = (col + sh >= 0) & (col + sh < W)
+        return jnp.where(okc, rolled, neg_row)
+    return gather
+
+
+def band_extents(Hrow, in_band, cols, inf32):
+    """(left, right): leftmost/rightmost band column achieving the row max,
+    or -1 when the row is all -inf. Reductions run in int32 (Mosaic has no
+    int16 reductions) as min/max over the masked column index (no reversal,
+    which does not lower)."""
+    Hrow32 = Hrow.astype(jnp.int32)
+    mx = jnp.max(Hrow32)
+    eq = (Hrow32 == mx) & in_band
+    has = mx > inf32
+    left = jnp.where(has, jnp.min(jnp.where(eq, cols, 2**30)), -1)
+    right = jnp.where(has, jnp.max(jnp.where(eq, cols, -1)), -1)
+    return left, right
+
+
+def qp_band_row(qp_ref, base_v, beg, W: int):
+    """The (1, W) query-profile band window for row base `base_v` starting at
+    column `beg`: whole-row load + dynamic rotate (dynamic lane starts do not
+    lower for VMEM loads). Never wraps: the row carries W lanes of padding."""
+    qp_full = qp_ref[pl.ds(base_v, 1), :]
+    return pltpu.roll(qp_full, jnp.mod(-beg, qp_full.shape[1]), axis=1)[:, :W]

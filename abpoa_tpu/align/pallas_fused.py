@@ -2,8 +2,10 @@
 
 Differences from pallas_kernel.py (the per-alignment prototype):
 - sized for fused-loop graphs (R up to ~100k rows): per-row tables arrive as
-  blocked VMEM streams (one (1, x) block per grid step) instead of R-sized
-  SMEM arrays, which would blow the ~1 MB SMEM budget;
+  one packed (R, L) int32 metadata array streamed through VMEM in B-row
+  blocks (Mosaic requires >=8-sublane blocks; (1, x) SMEM streams do not
+  lower), and the DP planes stream out in matching B-row blocks with the
+  standard revisiting index map;
 - band metadata lives in small SMEM rings: measured predecessor/successor
   topo-distances on real 10 kb read sets peak at 18-31 rows (PERF.md), so a
   D=512 ring gives ~16x headroom and the overflow flag fires effectively
@@ -32,42 +34,54 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .. import constants as C
+from .pallas_common import (BLOCK_B, band_extents, make_ring_gather,
+                            qp_band_row, roll_any)
 
 # ring capacity (rows) for predecessor windows and band scalars
 RING_D = 512
+
+# packed per-row metadata lane layout (see _pack_meta)
+_M_BASE, _M_NPRE, _M_NOUT, _M_REMAIN, _M_TAB = 0, 1, 2, 3, 4
 
 
 def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
+    B = BLOCK_B
 
-    def kernel(sc_ref, base_ref, pre_idx_ref, pre_cnt_ref, out_idx_ref,
-               out_cnt_ref, remain_ref, row0H_ref, row0E1_ref, row0E2_ref,
-               qp_ref,
+    def kernel(sc_ref, meta_ref, row0H_ref, row0E1_ref, row0E2_ref, qp_ref,
                H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
                ok_out, *scratch):
+        if plane16:
+            # i16 plane rows cannot be stored at dynamic sublane offsets:
+            # rows accumulate in i32 staging blocks, flushed (cast + whole-
+            # block store, static index) once per B rows
+            stag = scratch[-5:]
+            scratch = scratch[:-5]
         if convex:
-            (ringH, ringE1, ringE2, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+            (ringH, ringE1, ringE2, beg_s, end_s, mpl_s, mpr_s, ok_s,
+             smeta, sem) = scratch
         elif linear:
-            (ringH, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+            (ringH, beg_s, end_s, mpl_s, mpr_s, ok_s, smeta, sem) = scratch
             ringE1 = ringE2 = None
         else:
-            (ringH, ringE1, beg_s, end_s, mpl_s, mpr_s, ok_s) = scratch
+            (ringH, ringE1, beg_s, end_s, mpl_s, mpr_s, ok_s,
+             smeta, sem) = scratch
             ringE2 = None
         i = pl.program_id(0)
         n_steps = pl.num_programs(0)
         qlen = sc_ref[0]
         w = sc_ref[1]
         remain_end = sc_ref[2]
-        inf = sc_ref[3].astype(dt)
-        e1, oe1 = sc_ref[4].astype(dt), sc_ref[5].astype(dt)
-        e2, oe2 = sc_ref[6].astype(dt), sc_ref[7].astype(dt)
+        inf = sc_ref[3]
+        e1, oe1 = sc_ref[4], sc_ref[5]
+        e2, oe2 = sc_ref[6], sc_ref[7]
         gn = sc_ref[8]
         end0 = sc_ref[9]
 
         col = lax.broadcasted_iota(jnp.int32, (1, W), 1)
-        neg_row = jnp.full((1, W), inf, dt)
+        neg_row = jnp.full((1, W), inf, jnp.int32)
 
         @pl.when(i == 0)
         def _init():
@@ -91,11 +105,19 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
                 ringE2[0, :] = row0E2_ref[0, :]
 
         row = i + 1
+        sub = row % B  # row's slot inside the current B-row block
         active = (row < gn - 1) & (ok_s[0] == 1)
+
+        # Mosaic rejects dynamic lane indexing of VMEM, so the row's packed
+        # metadata is DMAed into SMEM where dynamic scalar reads are free
+        cp = pltpu.make_async_copy(
+            meta_ref.at[pl.ds(sub, 1), :], smeta, sem)
+        cp.start()
+        cp.wait()
 
         # the src's out rows get mpl=mpr=1 (first-row band seeding); the host
         # packs that flag into base's high bits to stay block-streamed
-        b_packed = base_ref[0, 0]
+        b_packed = smeta[0, _M_BASE]
         is_src_out = (b_packed & 0x100) != 0
         base_v = b_packed & 0xFF
 
@@ -110,15 +132,16 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
 
         @pl.when(active)
         def _row():
-            r = qlen - (remain_ref[0, 0] - remain_end - 1)
+            r = qlen - (smeta[0, _M_REMAIN] - remain_end - 1)
             mpl_v = mpl_s[row % D]
             mpr_v = mpr_s[row % D]
             beg = jnp.maximum(0, jnp.minimum(mpl_v, r) - w)
             end = jnp.minimum(qlen, jnp.maximum(mpr_v, r) + w)
-            npre = pre_cnt_ref[0, 0]
+            npre = smeta[0, _M_NPRE]
+            nout = smeta[0, _M_NOUT]
 
             def mpb(k, acc):
-                p = pre_idx_ref[0, k]
+                p = smeta[0, _M_TAB + k]
                 return jnp.minimum(acc, beg_s[p % D])
             min_pre_beg = lax.fori_loop(0, npre, mpb, jnp.int32(2**30))
             beg = jnp.maximum(beg, min_pre_beg)
@@ -126,12 +149,12 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
             # overflow: band wider than W, pred outside the ring, or a
             # successor further than the ring can scatter
             def povf(k, acc):
-                return acc | (row - pre_idx_ref[0, k] >= D)
+                return acc | (row - smeta[0, _M_TAB + k] >= D)
             ovf = lax.fori_loop(0, npre, povf, end - beg + 1 > W)
 
             def sovf(k, acc):
-                return acc | (out_idx_ref[0, k] - row >= D)
-            ovf = lax.fori_loop(0, out_cnt_ref[0, 0], sovf, ovf)
+                return acc | (smeta[0, _M_TAB + P + k] - row >= D)
+            ovf = lax.fori_loop(0, nout, sovf, ovf)
 
             @pl.when(ovf)
             def _():
@@ -142,15 +165,11 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
             cols = beg + col
             in_band = cols <= end
 
-            def gather(ring_ref, p, shift):
-                win = ring_ref[pl.ds(p % D, 1), :]
-                sh = jnp.clip(shift, -W, W)
-                padded = jnp.concatenate([neg_row, win, neg_row], axis=1)
-                return lax.dynamic_slice(padded, (0, W + sh), (1, W))
+            gather = make_ring_gather(col, neg_row, W, D)
 
             def pred_body(k, acc):
                 Mq, E1r, E2r = acc
-                p = pre_idx_ref[0, k]
+                p = smeta[0, _M_TAB + k]
                 pbeg = beg_s[p % D]
                 pend = end_s[p % D]
                 hs = gather(ringH, p, beg - 1 - pbeg)
@@ -173,17 +192,24 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
             Mq, E1r, E2r = lax.fori_loop(
                 0, npre, pred_body, (neg_row, neg_row, neg_row))
 
-            qprow = qp_ref[pl.ds(base_v, 1), pl.ds(beg, W)]
+            qprow = qp_band_row(qp_ref, base_v, beg, W)
             Mq = jnp.where(in_band, Mq + qprow, inf)
 
-            def chain(A, ext):
+            inf32 = sc_ref[3]
+
+            def chain(A, ext32):
+                # scalar ALU is i32-only on Mosaic: compute the clamp/step
+                # scalars in i32 and splat-cast into the plane dtype (two's
+                # complement truncation == native int16 wrap semantics)
                 F = A
                 shift = 1
                 while shift < W:
-                    rolled = pltpu.roll(F, shift, axis=1)
+                    rolled = roll_any(F, shift)
                     prev = jnp.where(col >= shift, rolled, inf)
-                    F = jnp.maximum(
-                        F, jnp.maximum(prev, inf + shift * ext) - shift * ext)
+                    clampv = jnp.full((1, W), inf32 + shift * ext32,
+                                      jnp.int32)
+                    subv = jnp.full((1, W), shift * ext32, jnp.int32)
+                    F = jnp.maximum(F, jnp.maximum(prev, clampv) - subv)
                     shift <<= 1
                 return F
 
@@ -193,7 +219,7 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
                 # linear branch; reference simd_abpoa_lg_dp :727-815)
                 Erow = jnp.where(in_band, E1r - e1, inf)
                 Hhat = jnp.maximum(Mq, Erow)
-                Hrow = jnp.where(in_band, chain(Hhat, e1), inf)
+                Hrow = jnp.where(in_band, chain(Hhat, sc_ref[4]), inf)
                 E1n = E2n = F1 = F2 = neg_row
             else:
                 E1r = jnp.where(in_band, E1r, inf)
@@ -201,16 +227,16 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
                 if convex:
                     E2r = jnp.where(in_band, E2r, inf)
                     Hhat = jnp.maximum(Hhat, E2r)
-                Hm1 = jnp.where(col >= 1, pltpu.roll(Hhat, 1, axis=1), inf)
+                Hm1 = jnp.where(col >= 1, roll_any(Hhat, 1), inf)
                 A1 = jnp.where(in_band,
                                jnp.where(col == 0, Mq - oe1, Hm1 - oe1), inf)
-                F1 = chain(A1, e1)
+                F1 = chain(A1, sc_ref[4])
                 Hrow = jnp.maximum(Hhat, F1)
                 if convex:
                     A2 = jnp.where(in_band,
                                    jnp.where(col == 0, Mq - oe2, Hm1 - oe2),
                                    inf)
-                    F2 = chain(A2, e2)
+                    F2 = chain(A2, sc_ref[6])
                     Hrow = jnp.maximum(Hrow, F2)
                     E1n = jnp.maximum(E1r - e1, Hrow - oe1)
                     E2n = jnp.maximum(E2r - e2, Hrow - oe2)
@@ -232,27 +258,25 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
                 ringE1[row % D, :] = E1n[0]
             if convex:
                 ringE2[row % D, :] = E2n[0]
-            H_out[0, :] = Hrow[0]
-            E1_out[0, :] = E1n[0]
-            E2_out[0, :] = E2n[0]
-            F1_out[0, :] = F1[0]
-            F2_out[0, :] = F2[0]
-            beg_out[0] = beg
-            end_out[0] = end
+            plane_rows = (Hrow, E1n, E2n, F1, F2)
+            plane_outs = (H_out, E1_out, E2_out, F1_out, F2_out)
+            if plane16:
+                for st, val in zip(stag, plane_rows):
+                    st[sub, :] = val[0]
+            else:
+                for o, val in zip(plane_outs, plane_rows):
+                    o[sub, :] = val[0]
+            beg_out[pl.ds(sub, 1), :] = jnp.full((1, 1), beg, jnp.int32)
+            end_out[pl.ds(sub, 1), :] = jnp.full((1, 1), end, jnp.int32)
 
-            mx = jnp.max(Hrow)
-            eq = (Hrow == mx) & in_band
-            has = mx > inf
-            left = jnp.where(has, beg + jnp.argmax(eq[0]).astype(jnp.int32), -1)
-            right = jnp.where(
-                has, beg + W - 1 - jnp.argmax(eq[0, ::-1]).astype(jnp.int32), -1)
+            left, right = band_extents(Hrow, in_band, cols, sc_ref[3])
 
             def out_body(k, _):
-                t = out_idx_ref[0, k]
+                t = smeta[0, _M_TAB + P + k]
                 mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
                 mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
                 return 0
-            lax.fori_loop(0, out_cnt_ref[0, 0], out_body, 0)
+            lax.fori_loop(0, nout, out_body, 0)
 
             # this row's mpl/mpr ring slot now belongs to row+D: reset it
             # AFTER all reads/writes of row's own value (successors of rows
@@ -263,19 +287,33 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
 
         @pl.when(~active)
         def _pad():
-            H_out[0, :] = neg_row[0]
-            E1_out[0, :] = neg_row[0]
-            E2_out[0, :] = neg_row[0]
-            F1_out[0, :] = neg_row[0]
-            F2_out[0, :] = neg_row[0]
-            beg_out[0] = 0
-            end_out[0] = 0
+            if plane16:
+                for st in stag:
+                    st[sub, :] = neg_row[0]
+            else:
+                for o in (H_out, E1_out, E2_out, F1_out, F2_out):
+                    o[sub, :] = neg_row[0]
+            zero11 = jnp.zeros((1, 1), jnp.int32)
+            beg_out[pl.ds(sub, 1), :] = zero11
+            end_out[pl.ds(sub, 1), :] = zero11
+
+        if plane16:
+            @pl.when((sub == B - 1) | (i == n_steps - 1))
+            def _flush_planes():
+                for o, st in zip((H_out, E1_out, E2_out, F1_out, F2_out),
+                                 stag):
+                    o[:, :] = st[:, :].astype(dt)
 
         @pl.when(i == n_steps - 1)
         def _flush():
             ok_out[0] = ok_s[0]
 
     return kernel
+
+
+def meta_lanes(P: int, O: int) -> int:
+    """Packed per-row metadata width, rounded up to full 128-lane registers."""
+    return -(-(_M_TAB + P + O) // 128) * 128
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -287,56 +325,65 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
                     interpret: bool = False):
     """Banded global forward DP for the fused loop (all gap regimes).
 
-    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) in the
-    plane dtype. row0*: (1, W) plane dtype. scalars: (16,) int32.
+    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) int32
+    (i16 VMEM rows cannot be addressed at dynamic sublane offsets; the kernel
+    casts the fetched band row). row0*: (1, W) plane dtype. scalars: (16,)
+    int32.
     Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok); planes are (R, W) in the
     plane dtype (int16 when plane16). Unused planes for the lighter regimes
     are -inf filled, matching _dp_banded.
     """
     D = RING_D
+    B = BLOCK_B
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
     kernel = _make_kernel(W, P, O, D, gap_mode, plane16)
     m = qp_pad.shape[0]
-    row_i32 = lambda width: pl.BlockSpec((1, width), lambda i: (i + 1, 0),
-                                         memory_space=pltpu.SMEM)
+    L = meta_lanes(P, O)
+    meta = jnp.concatenate(
+        [base_packed[:, None], pre_cnt[:, None], out_cnt[:, None],
+         remain_rows[:, None], pre_idx, out_idx], axis=1)
+    meta = jnp.pad(meta, ((0, 0), (0, L - meta.shape[1])))
     out_shapes = (
         [jax.ShapeDtypeStruct((R, W), dt)] * 5
-        + [jax.ShapeDtypeStruct((R,), jnp.int32),
-           jax.ShapeDtypeStruct((R,), jnp.int32),
+        + [jax.ShapeDtypeStruct((R, 1), jnp.int32),
+           jax.ShapeDtypeStruct((R, 1), jnp.int32),
            jax.ShapeDtypeStruct((1,), jnp.int32)])
-    plane = pl.BlockSpec((1, W), lambda i: (i + 1, 0), memory_space=pltpu.VMEM)
-    scalar_out = pl.BlockSpec((1,), lambda i: (i + 1,), memory_space=pltpu.SMEM)
-    out_specs = [plane] * 5 + [scalar_out, scalar_out,
-                               pl.BlockSpec((1,), lambda i: (0,),
-                                            memory_space=pltpu.SMEM)]
+    blk = lambda width: pl.BlockSpec((B, width), lambda i: ((i + 1) // B, 0),
+                                     memory_space=pltpu.VMEM)
+    out_specs = [blk(W)] * 5 + [blk(1), blk(1),
+                                pl.BlockSpec((1,), lambda i: (0,),
+                                             memory_space=pltpu.SMEM)]
     in_specs = [
         pl.BlockSpec((16,), lambda i: (0,), memory_space=pltpu.SMEM),
-        row_i32(1),                 # base_packed (1,1) per row
-        row_i32(P),                 # pre_idx
-        row_i32(1),                 # pre_cnt
-        row_i32(O),                 # out_idx
-        row_i32(1),                 # out_cnt
-        row_i32(1),                 # remain
+        blk(L),                     # packed per-row metadata
         pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((m, qp_pad.shape[1]), lambda i: (0, 0),
                      memory_space=pltpu.VMEM),
     ]
-    rings = [pltpu.VMEM((D, W), dt)]            # H ring
+    # rings are int32 regardless of plane width: Mosaic cannot address i16
+    # VMEM rows at dynamic sublane offsets (packed tiling); ring values are
+    # exact int16 so the read/write casts are lossless
+    rings = [pltpu.VMEM((D, W), jnp.int32)]            # H ring
     if not linear:
-        rings.append(pltpu.VMEM((D, W), dt))    # E1 ring
+        rings.append(pltpu.VMEM((D, W), jnp.int32))    # E1 ring
     if convex:
-        rings.append(pltpu.VMEM((D, W), dt))    # E2 ring
+        rings.append(pltpu.VMEM((D, W), jnp.int32))    # E2 ring
     scratch = rings + [
         pltpu.SMEM((D,), jnp.int32),   # beg ring
         pltpu.SMEM((D,), jnp.int32),   # end ring
         pltpu.SMEM((D,), jnp.int32),   # mpl ring
         pltpu.SMEM((D,), jnp.int32),   # mpr ring
         pltpu.SMEM((1,), jnp.int32),   # ok
+        pltpu.SMEM((1, L), jnp.int32),  # current row's metadata (DMA target)
+        pltpu.SemaphoreType.DMA,
     ]
+    if plane16:
+        # i32 staging blocks for the five plane outputs (see kernel)
+        scratch += [pltpu.VMEM((B, W), jnp.int32)] * 5
     fn = pl.pallas_call(
         kernel,
         grid=(R - 1,),
@@ -346,6 +393,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         scratch_shapes=scratch,
         interpret=interpret,
     )
-    return fn(scalars, base_packed.reshape(R, 1), pre_idx, pre_cnt.reshape(R, 1),
-              out_idx, out_cnt.reshape(R, 1), remain_rows.reshape(R, 1),
-              row0H, row0E1, row0E2, qp_pad)
+    (H, E1, E2, F1, F2, beg, end, ok) = fn(
+        scalars, meta, row0H.astype(jnp.int32), row0E1.astype(jnp.int32),
+        row0E2.astype(jnp.int32), qp_pad)
+    return H, E1, E2, F1, F2, beg[:, 0], end[:, 0], ok

@@ -15,9 +15,10 @@ the reference's actual working set — entirely on-chip:
 - adaptive-band state (max_pos_left/right, band begin/end) lives in SMEM
   scratch and is updated in-kernel, matching the reference's per-row
   propagation (abpoa_align_simd.c:1107-1130);
-- banded H/E1/E2/F1/F2 windows stream to HBM (one (1,W) block per grid step)
-  for the traceback; an `ok` flag reports band/ring overflow so the wrapper
-  can fall back to the full-width scan backend.
+- banded H/E1/E2/F1/F2 windows stream to HBM in B-row VMEM blocks with the
+  revisiting index map (Mosaic requires >=8-sublane blocks) for the
+  traceback; an `ok` flag reports band/ring overflow so the wrapper can fall
+  back to the full-width scan backend.
 
 Scope: convex-gap global banded mode (the default headline config); other
 modes/regimes run on the XLA-scan backend. Row 0 (the source row) is patched
@@ -36,9 +37,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .oracle import INT32_MIN
+from .pallas_common import BLOCK_B, band_extents, make_ring_gather, qp_band_row
 
 
 def _make_kernel(R, W, P, O, D, Qp):
+    B = BLOCK_B
     def kernel(sc_ref, base_ref, pre_idx_ref, pre_cnt_ref, out_idx_ref,
                out_cnt_ref, remain_ref, mpl0_ref, mpr0_ref, qp_ref,
                row0H_ref, row0E1_ref, row0E2_ref,
@@ -76,6 +79,7 @@ def _make_kernel(R, W, P, O, D, Qp):
             ringE2[0, :] = row0E2_ref[0, :]
 
         row = i + 1  # dp row computed by this grid step
+        sub = row % B  # row's slot inside the current B-row output block
         active = (row < gn - 1) & (ok_s[0] == 1)
 
         neg_row = jnp.full((1, W), inf, jnp.int32)
@@ -88,13 +92,13 @@ def _make_kernel(R, W, P, O, D, Qp):
             npre = pre_cnt_ref[row]
 
             def mpb_body(k, acc):
-                return jnp.minimum(acc, dp_beg_s[pre_idx_ref[row, k]])
+                return jnp.minimum(acc, dp_beg_s[pre_idx_ref[row * P + k]])
             min_pre_beg = lax.fori_loop(0, npre, mpb_body, jnp.int32(2**30))
             beg = jnp.maximum(beg, min_pre_beg)
 
             # overflow checks: band wider than W, or a pred outside the ring
             def ovf_body(k, acc):
-                return acc | (row - pre_idx_ref[row, k] >= D)
+                return acc | (row - pre_idx_ref[row * P + k] >= D)
             ovf = lax.fori_loop(0, npre, ovf_body, end - beg + 1 > W)
 
             @pl.when(ovf)
@@ -106,16 +110,11 @@ def _make_kernel(R, W, P, O, D, Qp):
             cols = beg + col
             in_band = cols <= end
 
-            def gather(ring_ref, p, shift):
-                win = ring_ref[pl.ds(p % D, 1), :]
-                sh = jnp.clip(shift, -W, W)
-                padded = jnp.concatenate(
-                    [neg_row, win, neg_row], axis=1)
-                return lax.dynamic_slice(padded, (0, W + sh), (1, W))
+            gather = make_ring_gather(col, neg_row, W, D)
 
             def pred_body(k, acc):
                 Mq, E1r, E2r = acc
-                p = pre_idx_ref[row, k]
+                p = pre_idx_ref[row * P + k]
                 pbeg = dp_beg_s[p]
                 pend = dp_end_s[p]
                 hs = gather(ringH, p, beg - 1 - pbeg)
@@ -131,7 +130,7 @@ def _make_kernel(R, W, P, O, D, Qp):
             Mq, E1r, E2r = lax.fori_loop(
                 0, npre, pred_body, (neg_row, neg_row, neg_row))
 
-            qprow = qp_ref[pl.ds(base_ref[row], 1), pl.ds(beg, W)]
+            qprow = qp_band_row(qp_ref, base_ref[row], beg, W)
             Mq = jnp.where(in_band, Mq + qprow, inf)
             E1r = jnp.where(in_band, E1r, inf)
             E2r = jnp.where(in_band, E2r, inf)
@@ -165,21 +164,16 @@ def _make_kernel(R, W, P, O, D, Qp):
             ringH[row % D, :] = Hrow[0]
             ringE1[row % D, :] = E1n[0]
             ringE2[row % D, :] = E2n[0]
-            H_out[0, :] = Hrow[0]
-            E1_out[0, :] = E1n[0]
-            E2_out[0, :] = E2n[0]
-            F1_out[0, :] = F1[0]
-            F2_out[0, :] = F2[0]
+            H_out[sub, :] = Hrow[0]
+            E1_out[sub, :] = E1n[0]
+            E2_out[sub, :] = E2n[0]
+            F1_out[sub, :] = F1[0]
+            F2_out[sub, :] = F2[0]
 
-            mx = jnp.max(Hrow)
-            eq = (Hrow == mx) & in_band
-            has = mx > inf
-            left = jnp.where(has, beg + jnp.argmax(eq[0]).astype(jnp.int32), -1)
-            right = jnp.where(
-                has, beg + W - 1 - jnp.argmax(eq[0, ::-1]).astype(jnp.int32), -1)
+            left, right = band_extents(Hrow, in_band, cols, inf)
 
             def out_body(k, _):
-                t = out_idx_ref[row, k]
+                t = out_idx_ref[row * O + k]
                 mpr_s[t] = jnp.maximum(mpr_s[t], right + 1)
                 mpl_s[t] = jnp.minimum(mpl_s[t], left + 1)
                 return 0
@@ -187,11 +181,11 @@ def _make_kernel(R, W, P, O, D, Qp):
 
         @pl.when(~active)
         def _pad():
-            H_out[0, :] = neg_row[0]
-            E1_out[0, :] = neg_row[0]
-            E2_out[0, :] = neg_row[0]
-            F1_out[0, :] = neg_row[0]
-            F2_out[0, :] = neg_row[0]
+            H_out[sub, :] = neg_row[0]
+            E1_out[sub, :] = neg_row[0]
+            E2_out[sub, :] = neg_row[0]
+            F1_out[sub, :] = neg_row[0]
+            F2_out[sub, :] = neg_row[0]
 
         @pl.when(i == n - 1)
         def _flush():
@@ -207,6 +201,17 @@ def _make_kernel(R, W, P, O, D, Qp):
     return kernel
 
 
+def smem_words(R: int, P: int, O: int, D: int) -> int:
+    """int32 words of SMEM the kernel allocates (inputs + outputs + scratch).
+    Kept next to the specs below; pallas_backend guards its calls with this
+    so oversized graphs fall back to the scan backend instead of failing at
+    Mosaic compile time (v5e SMEM is 1 MB/core)."""
+    inputs = 16 + R * (P + O + 6)   # scalars, base, tables, cnts, remain, mpl0/r0
+    outputs = 2 * R + 2 * R + 1     # begend, mplr, ok
+    scratch_ = 4 * R + 1            # dp_beg/end, mpl/mpr, ok
+    return inputs + outputs + scratch_
+
+
 def pallas_banded_dp(scalars: np.ndarray, base, pre_idx, pre_cnt, out_idx,
                      out_cnt, remain, mpl0, mpr0, qp_pad,
                      row0H, row0E1, row0E2,
@@ -217,7 +222,8 @@ def pallas_banded_dp(scalars: np.ndarray, base, pre_idx, pre_cnt, out_idx,
     kernel = _make_kernel(R, W, P, O, D, Qp)
     smem = lambda shape: pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
                                       memory_space=pltpu.SMEM)
-    plane = pl.BlockSpec((1, W), lambda i: (i + 1, 0), memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec((BLOCK_B, W), lambda i: ((i + 1) // BLOCK_B, 0),
+                         memory_space=pltpu.VMEM)
     out_shapes = (
         [jax.ShapeDtypeStruct((R, W), jnp.int32)] * 5
         + [jax.ShapeDtypeStruct((2 * R,), jnp.int32),
@@ -227,9 +233,9 @@ def pallas_banded_dp(scalars: np.ndarray, base, pre_idx, pre_cnt, out_idx,
     in_specs = [
         smem((16,)),            # scalars
         smem((R,)),             # base
-        smem((R, P)),           # pre_idx
+        smem((R * P,)),         # pre_idx (flattened: 2-D SMEM rows pad 512B)
         smem((R,)),             # pre_cnt
-        smem((R, O)),           # out_idx
+        smem((R * O,)),         # out_idx (flattened)
         smem((R,)),             # out_cnt
         smem((R,)),             # remain
         smem((R,)),             # mpl0
@@ -259,5 +265,6 @@ def pallas_banded_dp(scalars: np.ndarray, base, pre_idx, pre_cnt, out_idx,
         scratch_shapes=scratch,
         interpret=interpret,
     )
-    return fn(scalars, base, pre_idx, pre_cnt, out_idx, out_cnt, remain,
+    return fn(scalars, base, pre_idx.reshape(-1), pre_cnt,
+              out_idx.reshape(-1), out_cnt, remain,
               mpl0, mpr0, qp_pad, row0H, row0E1, row0E2)

@@ -44,3 +44,33 @@ def test_pallas_heter_2cons():
     got = run_cli([os.path.join(DATA_DIR, "heter.fa"), "-d2",
                    "--device", "pallas"])
     assert got == golden("ref_heter.txt")
+
+
+def test_pallas_perread_compiled_on_chip():
+    """Compiled parity for the per-read pallas backend (pallas_kernel.py) on
+    the real accelerator: the fused loop is stubbed out so the pipeline takes
+    the per-read dispatch path. Subprocess-isolated with a timeout."""
+    import subprocess
+    import sys
+    from test_pallas_fused import _accelerator_reachable
+    if not _accelerator_reachable():
+        pytest.skip("no accelerator reachable (wedged tunnel or CPU-only)")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = """
+import io, sys
+sys.path.insert(0, {root!r})
+import abpoa_tpu.align.fused_loop as fl
+fl.fused_eligible = lambda *a, **k: False
+from abpoa_tpu.cli import build_parser, args_to_params
+from abpoa_tpu.pipeline import Abpoa, msa_from_file
+ns = build_parser().parse_args([{path!r}, '--device', 'pallas'])
+abpt = args_to_params(ns).finalize()
+out = io.StringIO()
+msa_from_file(Abpoa(), abpt, ns.input, out)
+sys.stdout.write(out.getvalue())
+""".format(root=root, path=os.path.join(DATA_DIR, "seq.fa"))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=900,
+                          env={**os.environ, "ABPOA_TPU_SKIP_PROBE": "1"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout == golden("ref_consensus.txt")

@@ -87,21 +87,44 @@ def _accelerator_reachable():
 
 @pytest.mark.skipif(not _accelerator_reachable(),
                     reason="no accelerator reachable (wedged tunnel or CPU-only)")
-def test_pallas_fused_compiled_on_chip():
-    """Compiled (non-interpret) parity on the real accelerator, isolated in a
+@pytest.mark.parametrize("plane16", [False, True], ids=["int32", "int16"])
+@pytest.mark.parametrize("gap_kw", [
+    {},                                  # convex (default)
+    {"gap_open2": 0},                    # affine
+    {"gap_open1": 0, "gap_open2": 0},    # linear
+], ids=["convex", "affine", "linear"])
+def test_pallas_fused_compiled_on_chip(plane16, gap_kw):
+    """Compiled (non-interpret) parity on the real accelerator for every
+    kernel variant (both plane widths x all gap regimes), isolated in a
     subprocess with a timeout so a wedged device cannot hang the suite."""
     code = """
 import numpy as np, io, sys
 sys.path.insert(0, {root!r})
 import abpoa_tpu.align.fused_loop as fl
-fl.int16_score_limit = lambda abpt: -1
+if not {plane16}:
+    fl.int16_score_limit = lambda abpt: -1
+else:
+    # guard the parametrization: the run only exercises the int16 kernel
+    # variant if the test data still fits the int16 promotion bound
+    from abpoa_tpu.io.fastx import read_fastx as _rf
+    from abpoa_tpu.params import Params as _P
+    _abpt = _P()
+    for k, v in {gap_kw!r}.items():
+        setattr(_abpt, k, v)
+    _abpt.finalize()
+    _qmax = max(len(r.seq) for r in _rf({path!r}))
+    assert fl.max_score_bound(_abpt, _qmax, 2) <= fl.int16_score_limit(_abpt), \
+        'seq.fa no longer selects int16 planes; int16 on-chip coverage lost'
 from abpoa_tpu.params import Params
 from abpoa_tpu.io.fastx import read_fastx
 from abpoa_tpu.cons.consensus import generate_consensus
 from abpoa_tpu.io.output import output_fx_consensus
 
 def cons(use_pallas):
-    abpt = Params(); abpt.device = 'pallas'; abpt.finalize()
+    abpt = Params(); abpt.device = 'pallas'
+    for k, v in {gap_kw!r}.items():
+        setattr(abpt, k, v)
+    abpt.finalize()
     recs = read_fastx({path!r})
     enc = abpt.char_to_code
     seqs = [enc[np.frombuffer(r.seq.encode(), dtype=np.uint8)].astype(np.uint8)
@@ -115,7 +138,8 @@ def cons(use_pallas):
 assert cons(True) == cons(False), 'pallas-on-chip mismatch'
 print('ON-CHIP-OK')
 """.format(root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-           path=os.path.join(DATA_DIR, "seq.fa"))
+           path=os.path.join(DATA_DIR, "seq.fa"), plane16=plane16,
+           gap_kw=gap_kw)
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, timeout=900)
     assert "ON-CHIP-OK" in proc.stdout, proc.stderr[-2000:]
