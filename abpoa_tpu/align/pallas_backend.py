@@ -149,7 +149,7 @@ def align_sequence_to_subgraph_pallas(g: POAGraph, abpt: Params, beg_node_id: in
     # the kernel keeps all per-row tables in SMEM (1 MB/core on v5e): guard
     # the footprint and fall back to the full-width scan for huge graphs
     from .pallas_kernel import smem_words
-    if 4 * smem_words(R, P, O, D) > 650_000:
+    if 4 * smem_words(R, P, O) > 650_000:
         return align_sequence_to_subgraph_jax(g, abpt, beg_node_id, end_node_id, query)
 
     # row 0 init (source row), host-side

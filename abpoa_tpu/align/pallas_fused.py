@@ -4,8 +4,13 @@ Differences from pallas_kernel.py (the per-alignment prototype):
 - sized for fused-loop graphs (R up to ~100k rows): per-row tables arrive as
   one packed (R, L) int32 metadata array streamed through VMEM in B-row
   blocks (Mosaic requires >=8-sublane blocks; (1, x) SMEM streams do not
-  lower), and the DP planes stream out in matching B-row blocks with the
-  standard revisiting index map;
+  lower), DMAed block-at-a-time into SMEM for dynamic scalar reads, and the
+  DP planes stream out in matching B-row blocks with the standard revisiting
+  index map;
+- K rows compute per grid step (static unroll): rows still run strictly in
+  topo order inside the step, reading earlier rows through the VMEM rings,
+  so the per-step grid/pipelining overhead amortizes K-fold without touching
+  the sequential semantics;
 - band metadata lives in small SMEM rings: measured predecessor/successor
   topo-distances on real 10 kb read sets peak at 18-31 rows (PERF.md), so a
   D=512 ring gives ~16x headroom and the overflow flag fires effectively
@@ -16,8 +21,11 @@ Differences from pallas_kernel.py (the per-alignment prototype):
   abpoa_topological_sort;
 - covers all three gap regimes (linear/affine/convex, global banded) and
   both plane widths (int16 while the reference promotion bound allows,
-  int32 after — /root/reference/src/abpoa_align_simd.c:1293-1302). int16
-  planes double the effective VPU lanes exactly where most reads live.
+  int32 after — /root/reference/src/abpoa_align_simd.c:1293-1302). All
+  in-kernel math runs in int32 (i16 vector ops do not legalize on Mosaic;
+  the promotion bound guarantees every value fits int16, so int32 math is
+  bit-identical) — int16 survives at the HBM interface via staged casts,
+  halving plane traffic exactly where most reads live.
 
 Semantics are identical to fused_loop._dp_banded row for row; reference:
 /root/reference/src/abpoa_align_simd.c:727-1074 (lg/ag/cg kernels), band
@@ -39,16 +47,20 @@ from .pallas_common import (BLOCK_B, band_extents, make_ring_gather,
 
 # ring capacity (rows) for predecessor windows and band scalars
 RING_D = 512
+# rows computed per grid step (must divide BLOCK_B)
+UNROLL_K = 8
 
-# packed per-row metadata lane layout (see _pack_meta)
+# packed per-row metadata lane layout (see pallas_fused_dp)
 _M_BASE, _M_NPRE, _M_NOUT, _M_REMAIN, _M_TAB = 0, 1, 2, 3, 4
 
 
-def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
+def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
+                 K: int):
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
     B = BLOCK_B
+    steps_per_block = B // K
 
     def kernel(sc_ref, meta_ref, row0H_ref, row0E1_ref, row0E2_ref, qp_ref,
                H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
@@ -69,7 +81,7 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
             (ringH, ringE1, beg_s, end_s, mpl_s, mpr_s, ok_s,
              smeta, sem) = scratch
             ringE2 = None
-        i = pl.program_id(0)
+        g = pl.program_id(0)
         n_steps = pl.num_programs(0)
         qlen = sc_ref[0]
         w = sc_ref[1]
@@ -82,8 +94,9 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
 
         col = lax.broadcasted_iota(jnp.int32, (1, W), 1)
         neg_row = jnp.full((1, W), inf, jnp.int32)
+        gather = make_ring_gather(col, neg_row, W, D)
 
-        @pl.when(i == 0)
+        @pl.when(g == 0)
         def _init():
             ok_s[0] = jnp.where(end0 + 1 > W, 0, 1)
 
@@ -104,207 +117,215 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool):
             if convex:
                 ringE2[0, :] = row0E2_ref[0, :]
 
-        row = i + 1
-        sub = row % B  # row's slot inside the current B-row block
-        active = (row < gn - 1) & (ok_s[0] == 1)
+        # one DMA per B-row block (not per row): the whole resident metadata
+        # block drops into SMEM, where dynamic scalar reads are free
+        @pl.when(g % steps_per_block == 0)
+        def _load_meta():
+            cp = pltpu.make_async_copy(meta_ref, smeta, sem)
+            cp.start()
+            cp.wait()
 
-        # Mosaic rejects dynamic lane indexing of VMEM, so the row's packed
-        # metadata is DMAed into SMEM where dynamic scalar reads are free
-        cp = pltpu.make_async_copy(
-            meta_ref.at[pl.ds(sub, 1), :], smeta, sem)
-        cp.start()
-        cp.wait()
+        def chain(A, ext32):
+            # scalar ALU is i32-only on Mosaic: the clamp/step scalars stay
+            # i32 splats (identical to the scan path by the promotion bound)
+            F = A
+            shift = 1
+            while shift < W:
+                rolled = roll_any(F, shift)
+                prev = jnp.where(col >= shift, rolled, inf)
+                clampv = jnp.full((1, W), sc_ref[3] + shift * ext32,
+                                  jnp.int32)
+                subv = jnp.full((1, W), shift * ext32, jnp.int32)
+                F = jnp.maximum(F, jnp.maximum(prev, clampv) - subv)
+                shift <<= 1
+            return F
 
-        # the src's out rows get mpl=mpr=1 (first-row band seeding); the host
-        # packs that flag into base's high bits to stay block-streamed
-        b_packed = smeta[0, _M_BASE]
-        is_src_out = (b_packed & 0x100) != 0
-        base_v = b_packed & 0xFF
+        def emit_row(j):
+            """Row g*K + j: band update + DP + plane/ring writes. Rows run in
+            order inside the step; later rows read earlier rows' ring slots
+            exactly as across steps."""
+            row = g * K + j
+            sub = row % B
+            active = (row >= 1) & (row < gn - 1) & (ok_s[0] == 1)
 
-        @pl.when(active & is_src_out)
-        def _seed_src_out():
-            # src-out rows are seeded mpl=mpr=1 BEFORE the row loop in the
-            # sequential kernel; earlier rows may already have scattered onto
-            # this slot, so combine (min/max against the seed) instead of
-            # assigning — identical to seeding first and scattering after
-            mpl_s[row % D] = jnp.minimum(mpl_s[row % D], 1)
-            mpr_s[row % D] = jnp.maximum(mpr_s[row % D], 1)
+            # the src's out rows get mpl=mpr=1 (first-row band seeding); the
+            # host packs that flag into base's high bits to stay streamed
+            b_packed = smeta[sub, _M_BASE]
+            is_src_out = (b_packed & 0x100) != 0
+            base_v = b_packed & 0xFF
 
-        @pl.when(active)
-        def _row():
-            r = qlen - (smeta[0, _M_REMAIN] - remain_end - 1)
-            mpl_v = mpl_s[row % D]
-            mpr_v = mpr_s[row % D]
-            beg = jnp.maximum(0, jnp.minimum(mpl_v, r) - w)
-            end = jnp.minimum(qlen, jnp.maximum(mpr_v, r) + w)
-            npre = smeta[0, _M_NPRE]
-            nout = smeta[0, _M_NOUT]
+            @pl.when(active & is_src_out)
+            def _seed_src_out():
+                # src-out rows are seeded mpl=mpr=1 BEFORE the row loop in
+                # the sequential kernel; earlier rows may already have
+                # scattered onto this slot, so combine (min/max against the
+                # seed) instead of assigning — identical to seeding first
+                # and scattering after
+                mpl_s[row % D] = jnp.minimum(mpl_s[row % D], 1)
+                mpr_s[row % D] = jnp.maximum(mpr_s[row % D], 1)
 
-            def mpb(k, acc):
-                p = smeta[0, _M_TAB + k]
-                return jnp.minimum(acc, beg_s[p % D])
-            min_pre_beg = lax.fori_loop(0, npre, mpb, jnp.int32(2**30))
-            beg = jnp.maximum(beg, min_pre_beg)
+            @pl.when(active)
+            def _row():
+                r = qlen - (smeta[sub, _M_REMAIN] - remain_end - 1)
+                mpl_v = mpl_s[row % D]
+                mpr_v = mpr_s[row % D]
+                beg = jnp.maximum(0, jnp.minimum(mpl_v, r) - w)
+                end = jnp.minimum(qlen, jnp.maximum(mpr_v, r) + w)
+                npre = smeta[sub, _M_NPRE]
+                nout = smeta[sub, _M_NOUT]
 
-            # overflow: band wider than W, pred outside the ring, or a
-            # successor further than the ring can scatter
-            def povf(k, acc):
-                return acc | (row - smeta[0, _M_TAB + k] >= D)
-            ovf = lax.fori_loop(0, npre, povf, end - beg + 1 > W)
+                def mpb(k, acc):
+                    p = smeta[sub, _M_TAB + k]
+                    return jnp.minimum(acc, beg_s[p % D])
+                min_pre_beg = lax.fori_loop(0, npre, mpb, jnp.int32(2**30))
+                beg = jnp.maximum(beg, min_pre_beg)
 
-            def sovf(k, acc):
-                return acc | (smeta[0, _M_TAB + P + k] - row >= D)
-            ovf = lax.fori_loop(0, nout, sovf, ovf)
+                # overflow: band wider than W, pred outside the ring, or a
+                # successor further than the ring can scatter
+                def povf(k, acc):
+                    return acc | (row - smeta[sub, _M_TAB + k] >= D)
+                ovf = lax.fori_loop(0, npre, povf, end - beg + 1 > W)
 
-            @pl.when(ovf)
-            def _():
-                ok_s[0] = 0
-            beg_s[row % D] = beg
-            end_s[row % D] = end
+                def sovf(k, acc):
+                    return acc | (smeta[sub, _M_TAB + P + k] - row >= D)
+                ovf = lax.fori_loop(0, nout, sovf, ovf)
 
-            cols = beg + col
-            in_band = cols <= end
+                @pl.when(ovf)
+                def _():
+                    ok_s[0] = 0
+                beg_s[row % D] = beg
+                end_s[row % D] = end
 
-            gather = make_ring_gather(col, neg_row, W, D)
+                cols = beg + col
+                in_band = cols <= end
 
-            def pred_body(k, acc):
-                Mq, E1r, E2r = acc
-                p = smeta[0, _M_TAB + k]
-                pbeg = beg_s[p % D]
-                pend = end_s[p % D]
-                hs = gather(ringH, p, beg - 1 - pbeg)
-                hs = jnp.where((cols - 1 >= pbeg) & (cols - 1 <= pend), hs, inf)
-                Mq = jnp.maximum(Mq, hs)
-                eok = (cols >= pbeg) & (cols <= pend)
+                def pred_body(k, acc):
+                    Mq, E1r, E2r = acc
+                    p = smeta[sub, _M_TAB + k]
+                    pbeg = beg_s[p % D]
+                    pend = end_s[p % D]
+                    hs = gather(ringH, p, beg - 1 - pbeg)
+                    hs = jnp.where((cols - 1 >= pbeg) & (cols - 1 <= pend),
+                                   hs, inf)
+                    Mq = jnp.maximum(Mq, hs)
+                    eok = (cols >= pbeg) & (cols <= pend)
+                    if linear:
+                        # E contribution reads the predecessor H plane
+                        # directly (lg regime: no E plane exists)
+                        hj = gather(ringH, p, beg - pbeg)
+                        E1r = jnp.maximum(E1r, jnp.where(eok, hj, inf))
+                    else:
+                        e1s = gather(ringE1, p, beg - pbeg)
+                        E1r = jnp.maximum(E1r, jnp.where(eok, e1s, inf))
+                        if convex:
+                            e2s = gather(ringE2, p, beg - pbeg)
+                            E2r = jnp.maximum(E2r, jnp.where(eok, e2s, inf))
+                    return (Mq, E1r, E2r)
+
+                Mq, E1r, E2r = lax.fori_loop(
+                    0, npre, pred_body, (neg_row, neg_row, neg_row))
+
+                qprow = qp_band_row(qp_ref, base_v, beg, W)
+                Mq = jnp.where(in_band, Mq + qprow, inf)
+
                 if linear:
-                    # E contribution reads the predecessor H plane directly
-                    # (lg regime: no E plane exists)
-                    hj = gather(ringH, p, beg - pbeg)
-                    E1r = jnp.maximum(E1r, jnp.where(eok, hj, inf))
+                    # lg regime: Erow = max over preds of H[pre][j] - e1;
+                    # H row is an in-row gap chain over max(M, E)
+                    # (fused_loop._dp_banded linear branch; reference
+                    # simd_abpoa_lg_dp :727-815)
+                    Erow = jnp.where(in_band, E1r - e1, inf)
+                    Hhat = jnp.maximum(Mq, Erow)
+                    Hrow = jnp.where(in_band, chain(Hhat, sc_ref[4]), inf)
+                    E1n = E2n = F1 = F2 = neg_row
                 else:
-                    e1s = gather(ringE1, p, beg - pbeg)
-                    E1r = jnp.maximum(E1r, jnp.where(eok, e1s, inf))
+                    E1r = jnp.where(in_band, E1r, inf)
+                    Hhat = jnp.maximum(Mq, E1r)
                     if convex:
-                        e2s = gather(ringE2, p, beg - pbeg)
-                        E2r = jnp.maximum(E2r, jnp.where(eok, e2s, inf))
-                return (Mq, E1r, E2r)
-
-            Mq, E1r, E2r = lax.fori_loop(
-                0, npre, pred_body, (neg_row, neg_row, neg_row))
-
-            qprow = qp_band_row(qp_ref, base_v, beg, W)
-            Mq = jnp.where(in_band, Mq + qprow, inf)
-
-            inf32 = sc_ref[3]
-
-            def chain(A, ext32):
-                # scalar ALU is i32-only on Mosaic: compute the clamp/step
-                # scalars in i32 and splat-cast into the plane dtype (two's
-                # complement truncation == native int16 wrap semantics)
-                F = A
-                shift = 1
-                while shift < W:
-                    rolled = roll_any(F, shift)
-                    prev = jnp.where(col >= shift, rolled, inf)
-                    clampv = jnp.full((1, W), inf32 + shift * ext32,
-                                      jnp.int32)
-                    subv = jnp.full((1, W), shift * ext32, jnp.int32)
-                    F = jnp.maximum(F, jnp.maximum(prev, clampv) - subv)
-                    shift <<= 1
-                return F
-
-            if linear:
-                # lg regime: Erow = max over preds of H[pre][j] - e1; H row is
-                # an in-row gap chain over max(M, E) (fused_loop._dp_banded
-                # linear branch; reference simd_abpoa_lg_dp :727-815)
-                Erow = jnp.where(in_band, E1r - e1, inf)
-                Hhat = jnp.maximum(Mq, Erow)
-                Hrow = jnp.where(in_band, chain(Hhat, sc_ref[4]), inf)
-                E1n = E2n = F1 = F2 = neg_row
-            else:
-                E1r = jnp.where(in_band, E1r, inf)
-                Hhat = jnp.maximum(Mq, E1r)
-                if convex:
-                    E2r = jnp.where(in_band, E2r, inf)
-                    Hhat = jnp.maximum(Hhat, E2r)
-                Hm1 = jnp.where(col >= 1, roll_any(Hhat, 1), inf)
-                A1 = jnp.where(in_band,
-                               jnp.where(col == 0, Mq - oe1, Hm1 - oe1), inf)
-                F1 = chain(A1, sc_ref[4])
-                Hrow = jnp.maximum(Hhat, F1)
-                if convex:
-                    A2 = jnp.where(in_band,
-                                   jnp.where(col == 0, Mq - oe2, Hm1 - oe2),
+                        E2r = jnp.where(in_band, E2r, inf)
+                        Hhat = jnp.maximum(Hhat, E2r)
+                    Hm1 = jnp.where(col >= 1, roll_any(Hhat, 1), inf)
+                    A1 = jnp.where(in_band,
+                                   jnp.where(col == 0, Mq - oe1, Hm1 - oe1),
                                    inf)
-                    F2 = chain(A2, sc_ref[6])
-                    Hrow = jnp.maximum(Hrow, F2)
-                    E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-                    E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+                    F1 = chain(A1, sc_ref[4])
+                    Hrow = jnp.maximum(Hhat, F1)
+                    if convex:
+                        A2 = jnp.where(in_band,
+                                       jnp.where(col == 0, Mq - oe2,
+                                                 Hm1 - oe2), inf)
+                        F2 = chain(A2, sc_ref[6])
+                        Hrow = jnp.maximum(Hrow, F2)
+                        E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+                        E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+                    else:
+                        F2 = neg_row
+                        # ag regime gates E on H == Hhat (reference
+                        # simd_abpoa_ag_dp :817-933; affine branch)
+                        E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+                        E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                        E2n = neg_row
+                    Hrow = jnp.where(in_band, Hrow, inf)
+                    E1n = jnp.where(in_band, E1n, inf)
+                    E2n = jnp.where(in_band, E2n, inf)
+                    F1 = jnp.where(in_band, F1, inf)
+                    F2 = jnp.where(in_band, F2, inf)
+
+                ringH[row % D, :] = Hrow[0]
+                if not linear:
+                    ringE1[row % D, :] = E1n[0]
+                if convex:
+                    ringE2[row % D, :] = E2n[0]
+                plane_rows = (Hrow, E1n, E2n, F1, F2)
+                plane_outs = (H_out, E1_out, E2_out, F1_out, F2_out)
+                if plane16:
+                    for st, val in zip(stag, plane_rows):
+                        st[sub, :] = val[0]
                 else:
-                    F2 = neg_row
-                    # ag regime gates E on H == Hhat (reference
-                    # simd_abpoa_ag_dp :817-933; _dp_banded affine branch)
-                    E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-                    E1n = jnp.where(Hrow == Hhat, E1n, inf)
-                    E2n = neg_row
-                Hrow = jnp.where(in_band, Hrow, inf)
-                E1n = jnp.where(in_band, E1n, inf)
-                E2n = jnp.where(in_band, E2n, inf)
-                F1 = jnp.where(in_band, F1, inf)
-                F2 = jnp.where(in_band, F2, inf)
+                    for o, val in zip(plane_outs, plane_rows):
+                        o[sub, :] = val[0]
+                beg_out[pl.ds(sub, 1), :] = jnp.full((1, 1), beg, jnp.int32)
+                end_out[pl.ds(sub, 1), :] = jnp.full((1, 1), end, jnp.int32)
 
-            ringH[row % D, :] = Hrow[0]
-            if not linear:
-                ringE1[row % D, :] = E1n[0]
-            if convex:
-                ringE2[row % D, :] = E2n[0]
-            plane_rows = (Hrow, E1n, E2n, F1, F2)
-            plane_outs = (H_out, E1_out, E2_out, F1_out, F2_out)
-            if plane16:
-                for st, val in zip(stag, plane_rows):
-                    st[sub, :] = val[0]
-            else:
-                for o, val in zip(plane_outs, plane_rows):
-                    o[sub, :] = val[0]
-            beg_out[pl.ds(sub, 1), :] = jnp.full((1, 1), beg, jnp.int32)
-            end_out[pl.ds(sub, 1), :] = jnp.full((1, 1), end, jnp.int32)
+                left, right = band_extents(Hrow, in_band, cols, sc_ref[3])
 
-            left, right = band_extents(Hrow, in_band, cols, sc_ref[3])
+                def out_body(k, _):
+                    t = smeta[sub, _M_TAB + P + k]
+                    mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
+                    mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
+                    return 0
+                lax.fori_loop(0, nout, out_body, 0)
 
-            def out_body(k, _):
-                t = smeta[0, _M_TAB + P + k]
-                mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
-                mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
-                return 0
-            lax.fori_loop(0, nout, out_body, 0)
+                # this row's mpl/mpr ring slot now belongs to row+D: reset
+                # it AFTER all reads/writes of row's own value (successors
+                # of rows < row have already scattered; writers to row+D
+                # are rows > row, which run later)
+                mpl_s[row % D] = gn
+                mpr_s[row % D] = 0
 
-            # this row's mpl/mpr ring slot now belongs to row+D: reset it
-            # AFTER all reads/writes of row's own value (successors of rows
-            # < row have already scattered; writers to row+D are rows
-            # > row, which run later)
-            mpl_s[row % D] = gn
-            mpr_s[row % D] = 0
+            @pl.when(~active)
+            def _pad():
+                if plane16:
+                    for st in stag:
+                        st[sub, :] = neg_row[0]
+                else:
+                    for o in (H_out, E1_out, E2_out, F1_out, F2_out):
+                        o[sub, :] = neg_row[0]
+                zero11 = jnp.zeros((1, 1), jnp.int32)
+                beg_out[pl.ds(sub, 1), :] = zero11
+                end_out[pl.ds(sub, 1), :] = zero11
 
-        @pl.when(~active)
-        def _pad():
-            if plane16:
-                for st in stag:
-                    st[sub, :] = neg_row[0]
-            else:
-                for o in (H_out, E1_out, E2_out, F1_out, F2_out):
-                    o[sub, :] = neg_row[0]
-            zero11 = jnp.zeros((1, 1), jnp.int32)
-            beg_out[pl.ds(sub, 1), :] = zero11
-            end_out[pl.ds(sub, 1), :] = zero11
+        for j in range(K):
+            emit_row(j)
 
         if plane16:
-            @pl.when((sub == B - 1) | (i == n_steps - 1))
+            @pl.when((g % steps_per_block == steps_per_block - 1)
+                     | (g == n_steps - 1))
             def _flush_planes():
                 for o, st in zip((H_out, E1_out, E2_out, F1_out, F2_out),
                                  stag):
                     o[:, :] = st[:, :].astype(dt)
 
-        @pl.when(i == n_steps - 1)
+        @pl.when(g == n_steps - 1)
         def _flush():
             ok_out[0] = ok_s[0]
 
@@ -325,9 +346,8 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
                     interpret: bool = False):
     """Banded global forward DP for the fused loop (all gap regimes).
 
-    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) int32
-    (i16 VMEM rows cannot be addressed at dynamic sublane offsets; the kernel
-    casts the fetched band row). row0*: (1, W) plane dtype. scalars: (16,)
+    base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) int32.
+    row0*: (1, W) plane dtype (widened to int32 internally). scalars: (16,)
     int32.
     Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok); planes are (R, W) in the
     plane dtype (int16 when plane16). Unused planes for the lighter regimes
@@ -335,10 +355,12 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
     """
     D = RING_D
     B = BLOCK_B
+    K = UNROLL_K
+    assert B % K == 0
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
-    kernel = _make_kernel(W, P, O, D, gap_mode, plane16)
+    kernel = _make_kernel(W, P, O, D, gap_mode, plane16, K)
     m = qp_pad.shape[0]
     L = meta_lanes(P, O)
     meta = jnp.concatenate(
@@ -350,18 +372,20 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         + [jax.ShapeDtypeStruct((R, 1), jnp.int32),
            jax.ShapeDtypeStruct((R, 1), jnp.int32),
            jax.ShapeDtypeStruct((1,), jnp.int32)])
-    blk = lambda width: pl.BlockSpec((B, width), lambda i: ((i + 1) // B, 0),
+    # rows g*K..g*K+K-1 of grid step g stay inside one B-row block (K | B)
+    blk = lambda width: pl.BlockSpec((B, width),
+                                     lambda g: (g * K // B, 0),
                                      memory_space=pltpu.VMEM)
     out_specs = [blk(W)] * 5 + [blk(1), blk(1),
-                                pl.BlockSpec((1,), lambda i: (0,),
+                                pl.BlockSpec((1,), lambda g: (0,),
                                              memory_space=pltpu.SMEM)]
     in_specs = [
-        pl.BlockSpec((16,), lambda i: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((16,), lambda g: (0,), memory_space=pltpu.SMEM),
         blk(L),                     # packed per-row metadata
-        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, W), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((m, qp_pad.shape[1]), lambda i: (0, 0),
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, qp_pad.shape[1]), lambda g: (0, 0),
                      memory_space=pltpu.VMEM),
     ]
     # rings are int32 regardless of plane width: Mosaic cannot address i16
@@ -378,7 +402,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         pltpu.SMEM((D,), jnp.int32),   # mpl ring
         pltpu.SMEM((D,), jnp.int32),   # mpr ring
         pltpu.SMEM((1,), jnp.int32),   # ok
-        pltpu.SMEM((1, L), jnp.int32),  # current row's metadata (DMA target)
+        pltpu.SMEM((B, L), jnp.int32),  # current metadata block (DMA target)
         pltpu.SemaphoreType.DMA,
     ]
     if plane16:
@@ -386,7 +410,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         scratch += [pltpu.VMEM((B, W), jnp.int32)] * 5
     fn = pl.pallas_call(
         kernel,
-        grid=(R - 1,),
+        grid=(pl.cdiv(R, K),),
         out_shape=out_shapes,
         in_specs=in_specs,
         out_specs=out_specs,

@@ -201,7 +201,7 @@ def _make_kernel(R, W, P, O, D, Qp):
     return kernel
 
 
-def smem_words(R: int, P: int, O: int, D: int) -> int:
+def smem_words(R: int, P: int, O: int) -> int:
     """int32 words of SMEM the kernel allocates (inputs + outputs + scratch).
     Kept next to the specs below; pallas_backend guards its calls with this
     so oversized graphs fall back to the scan backend instead of failing at

@@ -73,6 +73,10 @@ def test_pallas_fused_matches_scan_int32_regimes(gap_kw, monkeypatch):
     assert _cons(path, True, **gap_kw) == _cons(path, False, **gap_kw)
 
 
+import functools
+
+
+@functools.lru_cache()
 def _accelerator_reachable():
     try:
         probe = subprocess.run(
