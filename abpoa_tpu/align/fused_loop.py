@@ -211,21 +211,28 @@ def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf,
         E20 = jnp.full(W, inf, dt)
     return H0, E10, E20, F10, F20
 
-@functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16"))
+@functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16",
+                                              "extend", "zdrop_on"))
 def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                remain_rows, mpl0, mpr0, qp, n_rows,
                qlen, w, remain_end, inf_min, dp_end0,
                o1, e1, oe1, o2, e2, oe2,
-               gap_mode: int, W: int, plane16: bool = False):
+               gap_mode: int, W: int, plane16: bool = False,
+               extend: bool = False, zdrop_on: bool = False, zdrop=0):
     """Adaptive-banded DP with W-wide windowed plane storage.
 
     Row i stores plane cells for absolute columns [dp_beg[i], dp_beg[i]+W);
     in-band cells outside [dp_beg, dp_end] and window cells past dp_end are
     -inf, matching the reference full-width semantics
     (/root/reference/src/abpoa_align_simd.c:935-1074, band macros
-    src/abpoa_align.h:34-35). Global mode only (the fused loop's scope).
+    src/abpoa_align.h:34-35). Global and extend modes; extend tracks the
+    running best cell with optional Z-drop termination
+    (set_extend_max_score, abpoa_align_simd.c:1082-1090) in int32 scalar
+    bookkeeping regardless of plane width, like the reference's scalar
+    best-score variables.
 
-    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, mpl, mpr, band_overflow).
+    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, mpl, mpr, band_overflow,
+    best_score, best_i, best_j).
     """
     R = base_r.shape[0]
     P = pre_idx.shape[1]
@@ -233,6 +240,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     # (the reference's width promotion, abpoa_align_simd.c:1293-1302)
     dt = jnp.int16 if plane16 else jnp.int32
     inf = inf_min.astype(dt)
+    inf32 = jnp.int32(inf_min)
+    e1_32 = jnp.int32(e1)
     o1, e1, oe1, o2, e2, oe2 = [x.astype(dt) for x in (o1, e1, oe1, o2, e2, oe2)]
     qp = qp.astype(dt)
     convex = gap_mode == C.CONVEX_GAP
@@ -312,7 +321,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         return v
 
     def body(st):
-        (i0, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
+        (i0, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow,
+         bs, bi, bj, brem, zdropped) = st
         lH = []
         lE1 = []
         lE2 = []
@@ -405,7 +415,25 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             left = jnp.where(has, beg + jnp.argmax(eq), -1).astype(jnp.int32)
             right = jnp.where(has, beg + W - 1 - jnp.argmax(eq[::-1]),
                               -1).astype(jnp.int32)
-            om = out_msk[i] & active
+            if extend:
+                mx32 = mx.astype(jnp.int32)
+                has_row = mx > inf
+                better = active & (~zdropped) & (mx32 > bs)
+                if zdrop_on:
+                    delta = brem - remain_rows[i]
+                    # empty-band rows Z-drop whenever a real best exists;
+                    # splitting the case avoids int32 wrap in bs - mx
+                    zd_real = has_row & \
+                        (bs - mx32 > zdrop
+                         + e1_32 * jnp.abs(delta - (right - bj)))
+                    zd = active & (~zdropped) & (~better) & \
+                        (zd_real | ((~has_row) & (bs > inf32)))
+                    zdropped = zdropped | zd
+                bs = jnp.where(better, mx32, bs)
+                bi = jnp.where(better, i, bi)
+                bj = jnp.where(better, right, bj)
+                brem = jnp.where(better, remain_rows[i], brem)
+            om = out_msk[i] & active & (~zdropped)
             tgt = jnp.where(om, out_idx[i], R)
             mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
             mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
@@ -430,19 +458,25 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         dp_beg = lax.dynamic_update_slice(dp_beg, jnp.stack(lbeg), (i0,))
         dp_end = lax.dynamic_update_slice(dp_end, jnp.stack(lend), (i0,))
         return (i0 + K, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                overflow)
+                overflow, bs, bi, bj, brem, zdropped)
 
     def cond(st):
         i = st[0]
-        overflow = st[-1]
-        return (i < n_rows - 1) & (~overflow)
+        overflow = st[10]
+        zdropped = st[15]
+        # Z-drop exits the row loop like the reference's break
+        # (set_extend_max_score); rows past the drop are never read back
+        # (backtrack starts at best_i, whose predecessors all precede it)
+        return (i < n_rows - 1) & (~overflow) & (~zdropped)
 
     st = (jnp.int32(1), Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+          jnp.bool_(False), inf32, jnp.int32(0), jnp.int32(0), jnp.int32(0),
           jnp.bool_(False))
     st = lax.while_loop(cond, body, st)
-    (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow) = st
+    (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow,
+     bs, bi, bj, _brem, _zd) = st
     return (Hb[:R], E1b[:R], E2b[:R], F1b[:R], F2b[:R],
-            dp_beg[:R], dp_end[:R], mpl[:-1], mpr[:-1], overflow)
+            dp_beg[:R], dp_end[:R], mpl[:-1], mpr[:-1], overflow, bs, bi, bj)
 
 
 # --------------------------------------------------------------------------- #
@@ -966,7 +1000,7 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
     "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths",
-    "amb_strand"))
+    "amb_strand", "extend", "zdrop_on"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
@@ -976,7 +1010,9 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     int16_limit: int = 0, use_pallas: bool = False,
                     pl_interpret: bool = False,
                     record_paths: bool = False,
-                    amb_strand: bool = False) -> FusedState:
+                    amb_strand: bool = False,
+                    extend: bool = False, zdrop_on: bool = False,
+                    zdrop=0) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -1029,9 +1065,12 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         remain_rows, mpl0, mpr0, qp_s, n,
                         qlen, w, remain_end, inf_min, dp_end0,
                         o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
-                        plane16=plane16)
+                        plane16=plane16, extend=extend, zdrop_on=zdrop_on,
+                        zdrop=zdrop)
 
-                if use_pallas:
+                # extend-mode best/Z-drop tracking is sequential state the
+                # Pallas kernel does not carry; extend reads take the scan
+                if use_pallas and not extend:
                     # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
                     # back in-jit to the XLA scan on ring/band overflow
                     # (measured rate on sim10k graphs: 0.0%, PERF.md). Covers
@@ -1068,30 +1107,37 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         return (Hp.at[0].set(H0), E1p.at[0].set(E10),
                                 E2p.at[0].set(E20), F1p.at[0].set(F10),
                                 F2p.at[0].set(F20), beg_p, end_p,
-                                zeros, zeros, jnp.bool_(False))
+                                zeros, zeros, jnp.bool_(False),
+                                jnp.int32(inf_min), jnp.int32(0),
+                                jnp.int32(0))
 
                     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                     overflow) = lax.cond(ok_p[0] == 1, take_pl,
-                                          dp_scan_path, None)
+                     overflow, ext_sc, ext_i, ext_j) = lax.cond(
+                         ok_p[0] == 1, take_pl, dp_scan_path, None)
                 else:
                     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                     overflow) = dp_scan_path(None)
+                     overflow, ext_sc, ext_i, ext_j) = dp_scan_path(None)
 
-                # global best over the sink's pred rows at their band ends
-                sink_rows = pre_idx[n - 1]
-                sink_msk = pre_msk[n - 1]
-                ends = jnp.minimum(qlen, dp_end[sink_rows])
-                kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
-                vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
-                                 & (ends - dp_beg[sink_rows] < W),
-                                 jnp.take_along_axis(Hb[sink_rows],
-                                                     kidx[:, None],
-                                                     axis=1)[:, 0],
-                                 inf_min)
-                kk = jnp.argmax(vals)
-                best_i = sink_rows[kk]
-                best_j = ends[kk]
-                best_sc = vals[kk].astype(jnp.int32)
+                if extend:
+                    # extend mode ends at the tracked best cell
+                    # (set_extend_max_score, abpoa_align_simd.c:1082-1090)
+                    best_i, best_j, best_sc = ext_i, ext_j, ext_sc
+                else:
+                    # global best over the sink's pred rows at their band ends
+                    sink_rows = pre_idx[n - 1]
+                    sink_msk = pre_msk[n - 1]
+                    ends = jnp.minimum(qlen, dp_end[sink_rows])
+                    kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
+                    vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
+                                     & (ends - dp_beg[sink_rows] < W),
+                                     jnp.take_along_axis(Hb[sink_rows],
+                                                         kidx[:, None],
+                                                         axis=1)[:, 0],
+                                     inf_min)
+                    kk = jnp.argmax(vals)
+                    best_i = sink_rows[kk]
+                    best_j = ends[kk]
+                    best_sc = vals[kk].astype(jnp.int32)
 
                 ops, n_ops, fin_i, fin_j, n_aln, n_match, bt_err = _backtrack_w(
                     Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
@@ -1306,10 +1352,9 @@ def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
 def fused_eligible(abpt: Params, n_seq: int) -> bool:
     """The fused device loop covers the reference's default progressive-POA
     configuration; other modes use the per-alignment backends."""
-    return (abpt.align_mode == C.GLOBAL_MODE
+    return (abpt.align_mode in (C.GLOBAL_MODE, C.EXTEND_MODE)
             and abpt.wb >= 0
             and not abpt.inc_path_score
-            and abpt.zdrop <= 0
             and not (abpt.use_qv and abpt.max_n_cons > 1)
             and not (abpt.incr_fn and abpt.use_read_ids)
             and abpt.ret_cigar
@@ -1436,6 +1481,8 @@ def progressive_poa_fused(seqs: List[np.ndarray],
 
     record_paths = bool(abpt.use_read_ids)
     amb = bool(abpt.amb_strand)
+    extend_m = abpt.align_mode == C.EXTEND_MODE
+    zdrop_on = extend_m and abpt.zdrop > 0
     if init_graph is not None and record_paths:
         # replayed bitsets cannot reconstruct the restored reads' edge sets
         raise RuntimeError(
@@ -1470,7 +1517,8 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             int16_limit=int(int16_limit),
             use_pallas=bool(use_pallas),
             pl_interpret=pl_interpret, record_paths=record_paths,
-            amb_strand=amb)
+            amb_strand=amb, extend=extend_m, zdrop_on=zdrop_on,
+            zdrop=jnp.int32(max(abpt.zdrop, 0)))
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:

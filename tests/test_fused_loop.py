@@ -242,3 +242,32 @@ def test_fused_pipeline_wiring():
     got = _consensus_via_host(path, device="jax")
     want = _consensus_via_host(path, device="numpy")
     assert got == want
+
+
+@pytest.mark.parametrize("flags", [["-m2"], ["-m2", "-z", "50"],
+                                   ["-m2", "-z", "5"]])
+def test_fused_extend_zdrop(flags):
+    """Extend mode (+ optional Z-drop) through the fused loop: the banded DP
+    tracks the running best cell and Z-drop exit exactly like the reference
+    (set_extend_max_score, src/abpoa_align_simd.c:1082-1090); output must
+    byte-match the host loop without falling back."""
+    import subprocess
+    path = os.path.join(DATA_DIR, "seq.fa")
+
+    def cli(device):
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import sys, runpy\n"
+            f"sys.argv = ['abpoa', '--device', {device!r}] + {flags!r} + [{path!r}]\n"
+            "runpy.run_module('abpoa_tpu.cli', run_name='__main__')\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert "falling back" not in proc.stderr
+        return proc.stdout
+
+    got = cli("jax")
+    assert got == cli("numpy")
+    if flags == ["-m2"]:
+        with open(os.path.join(GOLDEN_DIR, "seq_m2.txt")) as fp:
+            assert got == fp.read()
