@@ -21,6 +21,26 @@ from .result import AlignResult
 _BACKENDS: Dict[str, Callable] = {}
 
 
+def resolve_auto_device() -> str:
+    """Pick the fastest available engine, the analog of the reference's
+    startup ISA auto-selection (src/abpoa_dispatch_simd.c:59-82): a live
+    accelerator wins, then the native C++ host kernel, then the numpy
+    oracle. Called once per `Params.finalize()` for `device="auto"`; the
+    probe result is process-cached so repeated finalizes stay cheap."""
+    from ..utils.probe import has_accelerator
+    if has_accelerator():
+        # "jax" (the fused XLA-scan loop) until on-chip measurements prove
+        # the Pallas kernels faster end-to-end (BENCH_onchip.json)
+        return "jax"
+    try:
+        from ..native import load
+        if load() is not None:
+            return "native"
+    except Exception:
+        pass
+    return "numpy"
+
+
 def register_backend(name: str, fn: Callable) -> None:
     _BACKENDS[name] = fn
 

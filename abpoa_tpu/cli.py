@@ -51,8 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-h", "--help", action="help")
     p.add_argument("-v", "--version", action="version", version=__version__)
     p.add_argument("-V", "--verbose", type=int, default=0)
-    p.add_argument("--device", type=str, default="numpy",
-                   help="DP backend: numpy | jax | pallas [numpy]")
+    p.add_argument("--device", type=str, default="auto",
+                   help="DP backend: auto | numpy | native | jax | pallas "
+                        "[auto: accelerator if reachable, else native C++, "
+                        "else numpy; extend-mode reads (-m2) take the "
+                        "XLA-scan path even under pallas]")
     return p
 
 

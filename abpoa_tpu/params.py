@@ -108,8 +108,11 @@ class Params:
     verbose: int = C.VERBOSE_NONE
     batch_index: int = 0
 
-    # device backend for the DP kernel: "numpy" (oracle), "jax", "pallas"
-    device: str = "numpy"
+    # device backend for the DP kernel: "auto" resolves at finalize() to the
+    # fastest available engine (accelerator > native C++ > numpy oracle),
+    # mirroring the reference's runtime ISA dispatch; explicit "numpy",
+    # "native", "jax", "pallas" pin an engine
+    device: str = "auto"
 
     # derived (set by finalize)
     mat: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
@@ -148,6 +151,9 @@ class Params:
             self.mat = parse_mat_file(self.mat_fn, self.m)
             self.max_mat = int(self.mat.max())
             self.min_mis = int((-self.mat).max())
+        if self.device == "auto":
+            from .align.dispatch import resolve_auto_device
+            self.device = resolve_auto_device()
         self._finalized = True
         return self
 

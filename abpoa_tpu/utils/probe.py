@@ -18,12 +18,16 @@ simulating the wedged tunnel without needing one.
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Optional
 
 _PROBE_RESULT: Optional[bool] = None
+_PLATFORMS: Optional[frozenset] = None
 
 # generous enough for a cold jax import + backend init on a loaded host;
 # a wedged tunnel blocks far past this
@@ -45,6 +49,64 @@ _PROBE_CODE = (
 )
 
 
+# cross-process probe verdict cache: a CLI run on a host without an
+# accelerator would otherwise pay the full cold-jax-import subprocess probe
+# (seconds, up to the timeout on a wedged tunnel) on EVERY invocation now
+# that device="auto" is the default. TTL 0 disables the file cache.
+_CACHE_TTL = float(os.environ.get("ABPOA_TPU_PROBE_CACHE_TTL", "300"))
+
+
+def _cache_path() -> str:
+    # a user-private directory, NOT world-writable /tmp: a predictable /tmp
+    # path could be pre-created by another user with a planted verdict
+    base = os.environ.get("XDG_RUNTIME_DIR") or os.path.expanduser("~/.cache")
+    d = os.path.join(base, "abpoa_tpu")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+    except Exception:
+        d = tempfile.gettempdir()
+    return os.path.join(d, "probe_verdict.json")
+
+
+def _cache_fingerprint() -> str:
+    # the verdict depends on the environment the probe child ran under; a
+    # pinned run's verdict must not be replayed for an unpinned run
+    return "|".join([os.environ.get("JAX_PLATFORMS", ""),
+                     os.environ.get("ABPOA_TPU_PROBE_TIMEOUT", "")])
+
+
+def _cache_read():
+    if _CACHE_TTL <= 0 or os.environ.get("ABPOA_TPU_TEST_WEDGE"):
+        return None
+    try:
+        path = _cache_path()
+        st = os.stat(path)
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+            return None
+        with open(path) as fp:
+            d = json.load(fp)
+        age = time.time() - d["ts"]
+        if 0 <= age <= _CACHE_TTL and d.get("env") == _cache_fingerprint():
+            return bool(d["reachable"]), frozenset(d.get("platforms", []))
+    except Exception:
+        pass
+    return None
+
+
+def _cache_write(reachable: bool, platforms) -> None:
+    if _CACHE_TTL <= 0 or os.environ.get("ABPOA_TPU_TEST_WEDGE"):
+        return
+    try:
+        tmp = _cache_path() + ".tmp"
+        with open(tmp, "w") as fp:
+            json.dump({"ts": time.time(), "reachable": reachable,
+                       "platforms": sorted(platforms or []),
+                       "env": _cache_fingerprint()}, fp)
+        os.replace(tmp, _cache_path())
+    except Exception:
+        pass
+
+
 def jax_backend_reachable(timeout: float = None) -> bool:
     """True iff `jax.devices()` answers (any platform) within the timeout.
 
@@ -52,21 +114,80 @@ def jax_backend_reachable(timeout: float = None) -> bool:
     the CPU backend (that is how the test suite exercises it). Only a probe
     that hangs or crashes routes callers to the host fallback.
     """
-    global _PROBE_RESULT
+    global _PROBE_RESULT, _PLATFORMS
     if _PROBE_RESULT is not None:
         return _PROBE_RESULT
     if os.environ.get("ABPOA_TPU_SKIP_PROBE"):
         _PROBE_RESULT = True
         return True
+    cached = _cache_read()
+    if cached is not None:
+        _PROBE_RESULT, _PLATFORMS = cached
+        return _PROBE_RESULT
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],
             capture_output=True, text=True,
             timeout=timeout if timeout is not None else _DEFAULT_TIMEOUT)
         _PROBE_RESULT = proc.returncode == 0 and "PLATFORMS" in proc.stdout
+        if _PROBE_RESULT:
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORMS "):
+                    _PLATFORMS = frozenset(line.split()[1].split(","))
     except Exception:
         _PROBE_RESULT = False
+    _cache_write(_PROBE_RESULT, _PLATFORMS)
     return _PROBE_RESULT
+
+
+def accelerator_platforms() -> frozenset:
+    """Platforms the liveness probe observed (e.g. {'tpu'} or {'cpu'}).
+
+    Under ABPOA_TPU_SKIP_PROBE the platforms are read in-process — the flag
+    is the caller's assertion that jax initialization is safe (the test
+    conftest pins JAX_PLATFORMS=cpu before setting it)."""
+    global _PLATFORMS
+    if _PLATFORMS is not None:
+        return _PLATFORMS
+    if os.environ.get("ABPOA_TPU_SKIP_PROBE"):
+        # only inspect jax in-process when JAX_PLATFORMS pins a platform:
+        # the config-level pin (applied below) is what makes init safe —
+        # without it the site hook's device plugin wins and a wedged tunnel
+        # hangs jax.devices() forever (round-2 finding). SKIP_PROBE with no
+        # pin therefore claims no accelerator instead of risking the hang.
+        p = os.environ.get("JAX_PLATFORMS")
+        if not p:
+            _PLATFORMS = frozenset()
+            return _PLATFORMS
+        try:
+            import jax
+            jax.config.update("jax_platforms", p)
+            _PLATFORMS = frozenset(x.platform for x in jax.devices())
+        except Exception:
+            _PLATFORMS = frozenset()
+        return _PLATFORMS
+    if not jax_backend_reachable():
+        return frozenset()
+    if _PLATFORMS is None:
+        # cache hole: reachability was decided under ABPOA_TPU_SKIP_PROBE
+        # (no platform list) and the flag has since been unset — run the
+        # real probe once for the platform list instead of guessing
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=_DEFAULT_TIMEOUT)
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORMS "):
+                    _PLATFORMS = frozenset(line.split()[1].split(","))
+        except Exception:
+            pass
+    return _PLATFORMS if _PLATFORMS is not None else frozenset()
+
+
+def has_accelerator() -> bool:
+    """True iff the probe saw a non-CPU platform (a real chip, not the
+    CPU fallback backend)."""
+    return any(p != "cpu" for p in accelerator_platforms())
 
 
 _WARNED = False
@@ -82,6 +203,7 @@ def warn_unreachable_once(msg: str) -> None:
 
 
 def reset_probe_cache() -> None:
-    global _PROBE_RESULT, _WARNED
+    global _PROBE_RESULT, _WARNED, _PLATFORMS
     _PROBE_RESULT = None
     _WARNED = False
+    _PLATFORMS = None

@@ -10,6 +10,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # the suite runs CPU-only: skip the out-of-process accelerator liveness probe
 # (tests/test_probe.py exercises the probe itself and clears this)
 os.environ["ABPOA_TPU_SKIP_PROBE"] = "1"
+# never read/write the cross-process probe verdict cache from tests: the
+# wedge-simulation children would poison it for real runs on this host (and
+# a stale real verdict would defeat the simulation)
+os.environ["ABPOA_TPU_PROBE_CACHE_TTL"] = "0"
+# persistent compilation cache: the device-path tests are dominated by XLA
+# compile time (minutes per pallas-interpret variant); cache across runs and
+# across the subprocess-isolated children, which inherit this env
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
 
 
 def _drop_accelerator_plugins():

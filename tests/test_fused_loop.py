@@ -14,7 +14,7 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tests.conftest import DATA_DIR  # noqa: E402
+from tests.conftest import DATA_DIR, GOLDEN_DIR  # noqa: E402
 
 from abpoa_tpu.params import Params  # noqa: E402
 from abpoa_tpu.pipeline import Abpoa, msa_from_file  # noqa: E402

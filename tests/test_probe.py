@@ -65,3 +65,24 @@ def test_probe_cache_and_reset():
         else:
             os.environ["ABPOA_TPU_SKIP_PROBE"] = prior
     probe.reset_probe_cache()
+
+
+def test_auto_device_resolves_concrete():
+    """device="auto" must resolve to a concrete engine at finalize():
+    the reference picks the fastest ISA at startup
+    (src/abpoa_dispatch_simd.c:59-82); on this CPU-pinned session the pick
+    is the native C++ kernel (or numpy when g++ is absent), never the
+    accelerator and never the literal "auto"."""
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.utils import probe
+    p = Params().finalize()
+    assert p.device in ("native", "numpy")
+    assert probe.has_accelerator() is False  # conftest pins JAX_PLATFORMS=cpu
+
+
+def test_pinned_device_survives_finalize():
+    from abpoa_tpu.params import Params
+    for name in ("numpy", "jax", "pallas"):
+        p = Params()
+        p.device = name
+        assert p.finalize().device == name

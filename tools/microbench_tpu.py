@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""On-chip microbenchmarks that settle the fused-loop design math.
+
+The north star (BASELINE.json): 500x10kb in <=16.4 s wall means the
+~17.5M sequential DP-row steps + ~0.83M backtrack steps must average
+<= ~0.9 us per step. Until a chip answers what a sequential step actually
+costs, every perf lever is speculation (VERDICT r3 #1). Each task prints
+one or more `MB {json}` lines for the watcher to collect:
+
+  floor   - us per trivial `lax.while_loop` iteration (sequential dispatch
+            floor for the scan path).
+  pallas  - us per Pallas grid step / per row on a synthetic R-row chain
+            graph (the fused kernel's steady state), at a given UNROLL_K
+            and plane width.
+  e2e     - reads/s for an end-to-end N x 10kb consensus run on a given
+            device backend (the real fused loop incl. graph update).
+
+Run each task in its own process: `pallas` patches UNROLL_K before the
+first trace, and jit caches would otherwise pin the first value.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def emit(**kw):
+    print("MB " + json.dumps(kw), flush=True)
+
+
+def _platform():
+    import jax
+    return jax.devices()[0].platform
+
+
+def task_floor(iters: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(x):
+        def body(st):
+            i, v = st
+            return i + 1, v + jnp.max(v) * 0  # touch a vector op per step
+        def cond(st):
+            return st[0] < iters
+        return lax.while_loop(cond, body, (jnp.int32(0), x))
+
+    x = jnp.zeros((8, 256), jnp.int32)
+    run(x)[1].block_until_ready()
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(x)[1].block_until_ready()
+        walls.append(time.perf_counter() - t0)
+    best = min(walls)
+    emit(task="floor", platform=_platform(), iters=iters,
+         wall_s=round(best, 4), us_per_iter=round(best / iters * 1e6, 3))
+
+
+def _synthetic_chain(R: int, W: int, w: int, m: int = 5):
+    """A chain POA graph (row i's sole predecessor is i-1): the steady-state
+    shape of a converged consensus graph, which is what the headline
+    workload's DP spends its time on."""
+    import numpy as np
+    qlen = R - 2
+    base = np.random.default_rng(0).integers(0, 4, size=R).astype(np.int32)
+    packed = base.copy()
+    packed[1] |= 0x100  # row 1 is the src's out row
+    pre_idx = np.maximum(np.arange(R, dtype=np.int32) - 1, 0)[:, None]
+    pre_cnt = (np.arange(R) >= 1).astype(np.int32)
+    out_idx = np.minimum(np.arange(R, dtype=np.int32) + 1, R - 1)[:, None]
+    out_cnt = (np.arange(R) <= R - 2).astype(np.int32)
+    remain = (R - 1 - np.arange(R)).astype(np.int32)
+    inf = -(2 ** 27)
+    e1, oe1, e2, oe2 = 2, 6, 1, 26
+    end0 = min(qlen, w)
+    scalars = np.zeros(16, np.int32)
+    scalars[:10] = [qlen, w, 0, inf, e1, oe1, e2, oe2, R, end0]
+    row0 = np.full((1, W), inf, np.int32)
+    row0[0, :end0 + 1] = -(oe1 + e1 * np.arange(end0 + 1))
+    row0[0, 0] = 0
+    qp = np.random.default_rng(1).integers(-4, 3, size=(m, qlen + W))
+    return scalars, packed, pre_idx, pre_cnt, out_idx, out_cnt, remain, row0, qp.astype(np.int32)
+
+
+def task_pallas(R: int, W: int, unroll_k: int, plane16: bool,
+                interpret: bool = False) -> None:
+    import abpoa_tpu.align.pallas_fused as pf
+    pf.UNROLL_K = unroll_k  # before the first trace
+    import jax.numpy as jnp
+
+    w = 110  # the adaptive-band half width for 10 kb reads (b + f*qlen)
+    (scalars, packed, pre_idx, pre_cnt, out_idx, out_cnt, remain,
+     row0, qp) = _synthetic_chain(R, W, w)
+    dt = jnp.int16 if plane16 else jnp.int32
+    row0d = jnp.asarray(row0, dt)
+
+    def run():
+        out = pf.pallas_fused_dp(
+            jnp.asarray(scalars), jnp.asarray(packed), jnp.asarray(pre_idx),
+            jnp.asarray(pre_cnt), jnp.asarray(out_idx), jnp.asarray(out_cnt),
+            jnp.asarray(remain), row0d, row0d, row0d, jnp.asarray(qp),
+            R=R, W=W, P=1, O=1, plane16=plane16, interpret=interpret)
+        out[0].block_until_ready()
+        return out
+
+    out = run()
+    ok = int(out[-1][0])
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        walls.append(time.perf_counter() - t0)
+    best = min(walls)
+    steps = -(-R // unroll_k)
+    emit(task="pallas", platform=_platform(), R=R, W=W, K=unroll_k,
+         plane16=plane16, ok=ok, wall_s=round(best, 4),
+         us_per_grid_step=round(best / steps * 1e6, 3),
+         us_per_row=round(best / R * 1e6, 3))
+
+
+def _ensure_sim(n_reads: int, ref_len: int = 10000) -> str:
+    import getpass
+    path = f"/tmp/mb_sim{ref_len}_{n_reads}.{getpass.getuser()}.fa"
+    try:
+        with open(path) as fp:
+            if sum(1 for l in fp if l.startswith(">")) == n_reads:
+                return path
+    except OSError:
+        pass
+    subprocess.run(
+        [sys.executable, os.path.join(HERE, "tests", "make_sim.py"),
+         "--ref-len", str(ref_len), "--n-reads", str(n_reads), "--err", "0.1",
+         "--seed", "11", "--out", path], check=True)
+    return path
+
+
+def task_e2e(device: str, n_reads: int, ref_len: int) -> None:
+    import io
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    path = _ensure_sim(n_reads, ref_len)
+    abpt = Params()
+    abpt.device = device
+    abpt.finalize()
+    t0 = time.perf_counter()
+    msa_from_file(Abpoa(), abpt, path, io.StringIO())
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    msa_from_file(Abpoa(), abpt, path, io.StringIO())
+    warm = time.perf_counter() - t0
+    emit(task="e2e", platform=_platform(), device=device, n_reads=n_reads,
+         ref_len=ref_len, cold_wall_s=round(cold, 3),
+         warm_wall_s=round(warm, 3),
+         reads_per_sec=round(n_reads / warm, 3))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", required=True,
+                    choices=["floor", "pallas", "e2e"])
+    ap.add_argument("--iters", type=int, default=100_000)
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--band", type=int, default=384)
+    ap.add_argument("--unroll-k", type=int, default=8)
+    ap.add_argument("--plane16", action="store_true")
+    ap.add_argument("--device", default="pallas")
+    ap.add_argument("--interpret", action="store_true",
+                    help="CPU shape/semantics validation only")
+    ap.add_argument("--n-reads", type=int, default=10)
+    ap.add_argument("--ref-len", type=int, default=10000)
+    a = ap.parse_args()
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(HERE, ".jax_cache"))
+    if a.task == "floor":
+        task_floor(a.iters)
+    elif a.task == "pallas":
+        task_pallas(a.rows, a.band, a.unroll_k, a.plane16, a.interpret)
+    else:
+        task_e2e(a.device, a.n_reads, a.ref_len)
+
+
+if __name__ == "__main__":
+    main()
