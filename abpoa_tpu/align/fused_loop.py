@@ -1068,13 +1068,13 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         plane16=plane16, extend=extend, zdrop_on=zdrop_on,
                         zdrop=zdrop)
 
-                # extend-mode best/Z-drop tracking is sequential state the
-                # Pallas kernel does not carry; extend reads take the scan
-                if use_pallas and not extend:
+                if use_pallas:
                     # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
                     # back in-jit to the XLA scan on ring/band overflow
                     # (measured rate on sim10k graphs: 0.0%, PERF.md). Covers
-                    # all three gap regimes and both plane widths.
+                    # all three gap regimes, both plane widths, and both
+                    # fused-eligible align modes (global + extend/Z-drop,
+                    # tracked in SMEM scalars).
                     from .pallas_fused import pallas_fused_dp
                     dtp = jnp.int16 if plane16 else jnp.int32
                     N_, E_ = pre_idx.shape
@@ -1091,13 +1091,15 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
                     qp_padW = jnp.pad(qp_s, ((0, 0), (0, W)))
                     sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1,
-                                    e2, oe2, n, dp_end0] + [jnp.int32(0)] * 6)
-                    (Hp, E1p, E2p, F1p, F2p, beg_p, end_p,
-                     ok_p) = pallas_fused_dp(
+                                    e2, oe2, n, dp_end0, jnp.int32(zdrop)]
+                                   + [jnp.int32(0)] * 5)
+                    (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p,
+                     ext_p) = pallas_fused_dp(
                         sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
                         remain_rows, row0H, row0E1, row0E2, qp_padW,
                         R=N_, W=W, P=E_, O=E_, gap_mode=gap_mode,
-                        plane16=plane16, interpret=pl_interpret)
+                        plane16=plane16, extend=extend, zdrop_on=zdrop_on,
+                        interpret=pl_interpret)
                     # the kernel writes rows 1..: patch the source row in
                     end_p = end_p.at[0].set(dp_end0)
                     beg_p = beg_p.at[0].set(0)
@@ -1108,8 +1110,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                                 E2p.at[0].set(E20), F1p.at[0].set(F10),
                                 F2p.at[0].set(F20), beg_p, end_p,
                                 zeros, zeros, jnp.bool_(False),
-                                jnp.int32(inf_min), jnp.int32(0),
-                                jnp.int32(0))
+                                ext_p[0], ext_p[1], ext_p[2])
 
                     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
                      overflow, ext_sc, ext_i, ext_j) = lax.cond(

@@ -2,7 +2,7 @@
 
 The fast all-host path (reference-speed, no accelerator required): graph,
 fusion, topo sort AND the banded DP + backtrack all run in C++; Python only
-orchestrates. Unsupported corners (inc_path_score) fall back to the oracle.
+orchestrates, including -G path scores (reference abpoa_graph.c:429-437).
 """
 from __future__ import annotations
 
@@ -18,10 +18,8 @@ from .result import AlignResult
 
 def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
                                       end_node_id: int, query: np.ndarray) -> AlignResult:
-    if abpt.inc_path_score or not getattr(g, "is_native", False):
+    if not getattr(g, "is_native", False):
         from .oracle import align_sequence_to_subgraph_numpy
-        if getattr(g, "is_native", False):
-            raise RuntimeError("native graph requires native-supported params")
         return align_sequence_to_subgraph_numpy(g, abpt, beg_node_id, end_node_id, query)
 
     lib = g._lib
@@ -33,6 +31,7 @@ def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
         abpt.zdrop, abpt.m, abpt.gap_open1, abpt.gap_ext1, abpt.gap_open2,
         abpt.gap_ext2, abpt.min_mis, 1 if abpt.put_gap_on_right else 0,
         1 if abpt.put_gap_at_end else 0, 1 if abpt.ret_cigar else 0,
+        1 if abpt.inc_path_score else 0,
     ], dtype=np.int32)
     cap = 2 * qlen + g.node_n + 16
     cig = np.zeros(cap, dtype=np.uint64)

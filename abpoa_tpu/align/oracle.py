@@ -364,6 +364,13 @@ def align_sequence_to_subgraph_numpy(g: POAGraph, abpt: Params, beg_node_id: int
                 best_score, best_i, best_j = v, dp_i, end
     res.best_score = best_score
 
+    # -V3 kernel-debug dump (the reference's __SIMD_DEBUG__ path,
+    # src/abpoa_align_simd.c:46-95); no-op below VERBOSE_LONG_DEBUG
+    from ..utils.logging import dump_dp_matrix
+    dump_dp_matrix(H, dp_beg, dp_end, g.index_to_node_id, beg_index,
+                   planes=(None if gap_mode == C.LINEAR_GAP
+                           else {"E1": E1, "F1": F1}))
+
     if abpt.ret_cigar:
         _backtrack(g, abpt, st, pre_index, pre_ids, beg_index, best_i, best_j,
                    qlen, query, res, gap_mode, inf_min)

@@ -37,17 +37,18 @@ def make_ring_gather(col, neg_row, W: int, D: int):
 
 
 def band_extents(Hrow, in_band, cols, inf32):
-    """(left, right): leftmost/rightmost band column achieving the row max,
-    or -1 when the row is all -inf. Reductions run in int32 (Mosaic has no
-    int16 reductions) as min/max over the masked column index (no reversal,
-    which does not lower)."""
+    """(left, right, mx, has): leftmost/rightmost band column achieving the
+    row max (or -1 when the row is all -inf), the int32 row max, and whether
+    a real max exists. Reductions run in int32 (Mosaic has no int16
+    reductions) as min/max over the masked column index (no reversal, which
+    does not lower)."""
     Hrow32 = Hrow.astype(jnp.int32)
     mx = jnp.max(Hrow32)
     eq = (Hrow32 == mx) & in_band
     has = mx > inf32
     left = jnp.where(has, jnp.min(jnp.where(eq, cols, 2**30)), -1)
     right = jnp.where(has, jnp.max(jnp.where(eq, cols, -1)), -1)
-    return left, right
+    return left, right, mx, has
 
 
 def qp_band_row(qp_ref, base_v, beg, W: int):

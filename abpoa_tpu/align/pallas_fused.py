@@ -55,7 +55,7 @@ _M_BASE, _M_NPRE, _M_NOUT, _M_REMAIN, _M_TAB = 0, 1, 2, 3, 4
 
 
 def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
-                 K: int):
+                 K: int, extend: bool = False, zdrop_on: bool = False):
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
@@ -64,7 +64,12 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
 
     def kernel(sc_ref, meta_ref, row0H_ref, row0E1_ref, row0E2_ref, qp_ref,
                H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
-               ok_out, *scratch):
+               ok_out, ext_out, *scratch):
+        if extend:
+            # best-cell tracking state (set_extend_max_score,
+            # src/abpoa_align_simd.c:1082-1090): [bs, bi, bj, brem, zdropped]
+            best_s = scratch[-1]
+            scratch = scratch[:-1]
         if plane16:
             # i16 plane rows cannot be stored at dynamic sublane offsets:
             # rows accumulate in i32 staging blocks, flushed (cast + whole-
@@ -99,6 +104,12 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
         @pl.when(g == 0)
         def _init():
             ok_s[0] = jnp.where(end0 + 1 > W, 0, 1)
+            if extend:
+                best_s[0] = inf
+                best_s[1] = 0
+                best_s[2] = 0
+                best_s[3] = 0
+                best_s[4] = 0
 
             def seed(k, _):
                 # mpl/mpr ring defaults (reference re-init: mpl=n, mpr=0);
@@ -286,14 +297,46 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                 beg_out[pl.ds(sub, 1), :] = jnp.full((1, 1), beg, jnp.int32)
                 end_out[pl.ds(sub, 1), :] = jnp.full((1, 1), end, jnp.int32)
 
-                left, right = band_extents(Hrow, in_band, cols, sc_ref[3])
+                left, right, mx, has_row = band_extents(Hrow, in_band, cols,
+                                                        sc_ref[3])
+
+                if extend:
+                    # sequential best/Z-drop bookkeeping in SMEM scalars,
+                    # mirroring _dp_banded's extend branch row for row. Rows
+                    # after a Z-drop keep computing planes (the grid cannot
+                    # break) but never touch best state or the band scatter,
+                    # so every backtrack-reachable output matches the scan's.
+                    rrem = smeta[sub, _M_REMAIN]
+                    bs, bj, brem = best_s[0], best_s[2], best_s[3]
+                    zdr = best_s[4] == 1
+                    better = (~zdr) & (mx > bs)
+                    if zdrop_on:
+                        delta = brem - rrem
+                        zd_real = has_row & \
+                            (bs - mx > sc_ref[10]
+                             + sc_ref[4] * jnp.abs(delta - (right - bj)))
+                        zd = (~zdr) & (~better) & \
+                            (zd_real | ((~has_row) & (bs > inf)))
+                        best_s[4] = jnp.where(zd, 1, best_s[4])
+                    best_s[0] = jnp.where(better, mx, bs)
+                    best_s[1] = jnp.where(better, row, best_s[1])
+                    best_s[2] = jnp.where(better, right, bj)
+                    best_s[3] = jnp.where(better, rrem, brem)
 
                 def out_body(k, _):
                     t = smeta[sub, _M_TAB + P + k]
                     mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
                     mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
                     return 0
-                lax.fori_loop(0, nout, out_body, 0)
+
+                if extend and zdrop_on:
+                    # the scan gates the scatter on the POST-update flag
+                    # (a row that trips Z-drop does not scatter)
+                    @pl.when(best_s[4] == 0)
+                    def _scatter():
+                        lax.fori_loop(0, nout, out_body, 0)
+                else:
+                    lax.fori_loop(0, nout, out_body, 0)
 
                 # this row's mpl/mpr ring slot now belongs to row+D: reset
                 # it AFTER all reads/writes of row's own value (successors
@@ -328,6 +371,16 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
         @pl.when(g == n_steps - 1)
         def _flush():
             ok_out[0] = ok_s[0]
+            if extend:
+                ext_out[0] = best_s[0]
+                ext_out[1] = best_s[1]
+                ext_out[2] = best_s[2]
+                ext_out[3] = best_s[4]
+            else:
+                ext_out[0] = inf
+                ext_out[1] = 0
+                ext_out[2] = 0
+                ext_out[3] = 0
 
     return kernel
 
@@ -338,20 +391,26 @@ def meta_lanes(P: int, O: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "R", "W", "P", "O", "gap_mode", "plane16", "interpret"))
+    "R", "W", "P", "O", "gap_mode", "plane16", "extend", "zdrop_on",
+    "interpret"))
 def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
                     remain_rows, row0H, row0E1, row0E2, qp_pad,
                     R: int, W: int, P: int, O: int,
                     gap_mode: int = C.CONVEX_GAP, plane16: bool = False,
+                    extend: bool = False, zdrop_on: bool = False,
                     interpret: bool = False):
-    """Banded global forward DP for the fused loop (all gap regimes).
+    """Banded forward DP for the fused loop (all gap regimes; global and
+    extend modes, extend with optional Z-drop — set_extend_max_score,
+    src/abpoa_align_simd.c:1076-1090).
 
     base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) int32.
     row0*: (1, W) plane dtype (widened to int32 internally). scalars: (16,)
-    int32.
-    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok); planes are (R, W) in the
-    plane dtype (int16 when plane16). Unused planes for the lighter regimes
-    are -inf filled, matching _dp_banded.
+    int32 with the Z-drop threshold at slot 10.
+    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, ok, ext); planes are (R, W)
+    in the plane dtype (int16 when plane16), ext is (4,) int32
+    [best_score, best_i, best_j, zdropped] (inf/0/0/0 when not extend).
+    Unused planes for the lighter regimes are -inf filled, matching
+    _dp_banded.
     """
     D = RING_D
     B = BLOCK_B
@@ -360,7 +419,8 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
-    kernel = _make_kernel(W, P, O, D, gap_mode, plane16, K)
+    kernel = _make_kernel(W, P, O, D, gap_mode, plane16, K,
+                          extend=extend, zdrop_on=zdrop_on)
     m = qp_pad.shape[0]
     L = meta_lanes(P, O)
     meta = jnp.concatenate(
@@ -371,13 +431,16 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         [jax.ShapeDtypeStruct((R, W), dt)] * 5
         + [jax.ShapeDtypeStruct((R, 1), jnp.int32),
            jax.ShapeDtypeStruct((R, 1), jnp.int32),
-           jax.ShapeDtypeStruct((1,), jnp.int32)])
+           jax.ShapeDtypeStruct((1,), jnp.int32),
+           jax.ShapeDtypeStruct((4,), jnp.int32)])
     # rows g*K..g*K+K-1 of grid step g stay inside one B-row block (K | B)
     blk = lambda width: pl.BlockSpec((B, width),
                                      lambda g: (g * K // B, 0),
                                      memory_space=pltpu.VMEM)
     out_specs = [blk(W)] * 5 + [blk(1), blk(1),
                                 pl.BlockSpec((1,), lambda g: (0,),
+                                             memory_space=pltpu.SMEM),
+                                pl.BlockSpec((4,), lambda g: (0,),
                                              memory_space=pltpu.SMEM)]
     in_specs = [
         pl.BlockSpec((16,), lambda g: (0,), memory_space=pltpu.SMEM),
@@ -408,6 +471,8 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
     if plane16:
         # i32 staging blocks for the five plane outputs (see kernel)
         scratch += [pltpu.VMEM((B, W), jnp.int32)] * 5
+    if extend:
+        scratch.append(pltpu.SMEM((5,), jnp.int32))  # best-cell state
     fn = pl.pallas_call(
         kernel,
         grid=(pl.cdiv(R, K),),
@@ -417,7 +482,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
         scratch_shapes=scratch,
         interpret=interpret,
     )
-    (H, E1, E2, F1, F2, beg, end, ok) = fn(
+    (H, E1, E2, F1, F2, beg, end, ok, ext) = fn(
         scalars, meta, row0H.astype(jnp.int32), row0E1.astype(jnp.int32),
         row0E2.astype(jnp.int32), qp_pad)
-    return H, E1, E2, F1, F2, beg[:, 0], end[:, 0], ok
+    return H, E1, E2, F1, F2, beg[:, 0], end[:, 0], ok, ext

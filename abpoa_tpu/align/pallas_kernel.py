@@ -170,7 +170,7 @@ def _make_kernel(R, W, P, O, D, Qp):
             F1_out[sub, :] = F1[0]
             F2_out[sub, :] = F2[0]
 
-            left, right = band_extents(Hrow, in_band, cols, inf)
+            left, right, _, _ = band_extents(Hrow, in_band, cols, inf)
 
             def out_body(k, _):
                 t = out_idx_ref[row * O + k]

@@ -54,8 +54,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", type=str, default="auto",
                    help="DP backend: auto | numpy | native | jax | pallas "
                         "[auto: accelerator if reachable, else native C++, "
-                        "else numpy; extend-mode reads (-m2) take the "
-                        "XLA-scan path even under pallas]")
+                        "else numpy]")
     return p
 
 

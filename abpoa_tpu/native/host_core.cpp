@@ -38,6 +38,7 @@ struct Graph {
     std::vector<int32_t> wsH, wsE1, wsE2, wsF1, wsF2;
     std::vector<int32_t> ws_qprof;  // per-alignment query profile (m x qlen+1)
     std::vector<int32_t> ws_pre, ws_pre_off;  // flattened per-row pred lists
+    std::vector<int32_t> ws_pre_ps;  // -G path score per pred slot (CSR twin)
     std::vector<uint8_t> ws_index_map;
     std::vector<int32_t> ws_queue, ws_degree;  // BFS scratch (topo sort)
     std::vector<int64_t> ws_row_ptr;
@@ -550,6 +551,20 @@ int apg_get_remain(void* h, int32_t* remain) {
     return 0;
 }
 
+// -G log-scaled path score for in-edge `in_pos` of `nid`
+// (reference abpoa_graph.c:429-437; C round() = half away from zero)
+static int32_t incre_path_score(Graph& g, int nid, int in_pos) {
+    int pre_id = g.nodes[nid].in_ids[in_pos];
+    const Node& pre = g.nodes[pre_id];
+    int64_t node_w = 0;
+    for (int32_t w : pre.out_w) node_w += w;
+    int64_t edge_w = g.nodes[nid].in_w[in_pos];
+    if (node_w == 0 || edge_w == 0) return 0;
+    double v = std::log((double)edge_w / (double)node_w);
+    int32_t s = (int32_t)(v >= 0 ? std::floor(v + 0.5) : std::ceil(v - 0.5));
+    return std::max(s, (int32_t)-20);
+}
+
 // subgraph closure expansion (abpoa_graph.c:595-678)
 static bool is_full_upstream(Graph& g, int up, int down, int beg, int end) {
     int mn = std::min(up, beg), mx = std::max(down, end);
@@ -709,6 +724,7 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     const bool gap_on_right = params[11] != 0;
     const bool put_gap_at_end_flag = params[12] != 0;
     const bool ret_cigar = params[13] != 0;
+    const bool inc_ps = params[14] != 0;  // -G path scores
     const bool local = align_mode == 1, extend = align_mode == 2;
     const bool banded = wb >= 0;
     const bool linear = gap_mode == 0, convex = gap_mode == 2;
@@ -733,18 +749,27 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             index_map[g.node_id_to_index[out_id]] = 1;
     }
 
-    // filtered predecessor lists per dp row, flattened CSR
+    // filtered predecessor lists per dp row, flattened CSR (+ -G path score
+    // per kept slot: ps keys by the ORIGINAL in-edge position, so it must be
+    // computed here where that position is still known)
     std::vector<int32_t>& pre_flat = g.ws_pre;
     std::vector<int32_t>& pre_off = g.ws_pre_off;
+    std::vector<int32_t>& pre_ps = g.ws_pre_ps;
     if ((int)pre_off.size() < gn + 1) pre_off.resize(gn + 1);
     pre_flat.clear();
+    if (inc_ps) pre_ps.clear();
     pre_off[0] = pre_off[1] = 0;
     for (int i = 1; i < gn; ++i) {
         if (index_map[beg_index + i]) {
             int nid = g.index_to_node_id[beg_index + i];
-            for (int in_id : g.nodes[nid].in_ids) {
-                int p = g.node_id_to_index[in_id];
-                if (index_map[p]) pre_flat.push_back(p - beg_index);
+            const auto& in_ids = g.nodes[nid].in_ids;
+            for (size_t k = 0; k < in_ids.size(); ++k) {
+                int p = g.node_id_to_index[in_ids[k]];
+                if (index_map[p]) {
+                    pre_flat.push_back(p - beg_index);
+                    if (inc_ps)
+                        pre_ps.push_back(incre_path_score(g, nid, (int)k));
+                }
             }
         }
         pre_off[i + 1] = (int32_t)pre_flat.size();
@@ -865,7 +890,12 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         const uint8_t base = g.nodes[nid].base;
         const int32_t* qrow = qprof.data() + (int64_t)base * (qlen + 1);
 
-        for (int p : pre[i]) {
+        for (int32_t t = pre_off[i]; t < pre_off[i + 1]; ++t) {
+            const int p = pre_flat[t];
+            // -G adds the pred's path score to every contribution
+            // (oracle.py:232-245; reference abpoa_graph.c:429-437); the
+            // ps==0 bodies keep the non-G inner loops byte-for-byte intact
+            const int32_t ps = inc_ps ? pre_ps[t] : 0;
             const int pb = dp.beg[p], pe = dp.end[p];
             const int64_t pp = dp.row_ptr[p];
             // M from pred H at j-1: overlap of [b,e] with [pb+1, pe+1]
@@ -873,8 +903,13 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                 const int lo = std::max(b, pb + 1), hi = std::min(e, pe + 1);
                 const int32_t* Hp = dp.H.data() + pp - pb;  // Hp[j-1] valid
                 int32_t* Mqp = Mq.data() - b;
-                for (int j = lo; j <= hi; ++j)
-                    Mqp[j] = std::max(Mqp[j], Hp[j - 1]);
+                if (ps == 0) {
+                    for (int j = lo; j <= hi; ++j)
+                        Mqp[j] = std::max(Mqp[j], Hp[j - 1]);
+                } else {
+                    for (int j = lo; j <= hi; ++j)
+                        Mqp[j] = std::max(Mqp[j], Hp[j - 1] + ps);
+                }
             }
             // E from pred at j: overlap of [b,e] with [pb, pe]
             {
@@ -882,23 +917,44 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                 if (linear) {
                     const int32_t* Hp = dp.H.data() + pp - pb;
                     int32_t* Ep = E1r.data() - b;
+                    const int32_t d = e1 - ps;
                     for (int j = lo; j <= hi; ++j)
-                        Ep[j] = std::max(Ep[j], Hp[j] - e1);
+                        Ep[j] = std::max(Ep[j], Hp[j] - d);
                 } else {
                     const int32_t* E1p = dp.E1.data() + pp - pb;
                     int32_t* Ep = E1r.data() - b;
-                    for (int j = lo; j <= hi; ++j)
-                        Ep[j] = std::max(Ep[j], E1p[j]);
+                    if (ps == 0) {
+                        for (int j = lo; j <= hi; ++j)
+                            Ep[j] = std::max(Ep[j], E1p[j]);
+                    } else {
+                        for (int j = lo; j <= hi; ++j)
+                            Ep[j] = std::max(Ep[j], E1p[j] + ps);
+                    }
                     if (convex) {
                         const int32_t* E2p = dp.E2.data() + pp - pb;
                         int32_t* E2o = E2r.data() - b;
-                        for (int j = lo; j <= hi; ++j)
-                            E2o[j] = std::max(E2o[j], E2p[j]);
+                        if (ps == 0) {
+                            for (int j = lo; j <= hi; ++j)
+                                E2o[j] = std::max(E2o[j], E2p[j]);
+                        } else {
+                            for (int j = lo; j <= hi; ++j)
+                                E2o[j] = std::max(E2o[j], E2p[j] + ps);
+                        }
                     }
                 }
             }
         }
-        if (local && b == 0 && Mq[0] < 0) Mq[0] = 0;  // H[-1] treated as 0
+        if (local && b == 0) {
+            // H[-1] treated as 0; under -G the lead carries the path score,
+            // so the seed is max over preds of (0 + ps) (oracle.py:237-241)
+            int32_t lead = 0;
+            if (inc_ps && pre_off[i] < pre_off[i + 1]) {
+                lead = pre_ps[pre_off[i]];
+                for (int32_t t = pre_off[i] + 1; t < pre_off[i + 1]; ++t)
+                    lead = std::max(lead, pre_ps[t]);
+            }
+            if (Mq[0] < lead) Mq[0] = lead;
+        }
         // add query profile; Hhat = max(M+q, E) — contiguous, vectorizable
         Hh.resize(width);  // fully overwritten below; no fill needed
         {
@@ -1049,9 +1105,11 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         int32_t Hij = dp.h(i, j);
 
         auto try_match = [&]() -> bool {
-            for (int p : pre[i]) {
+            for (int32_t t = pre_off[i]; t < pre_off[i + 1]; ++t) {
+                const int p = pre_flat[t];
+                const int32_t ps = inc_ps ? pre_ps[t] : 0;
                 if (j - 1 < dp.beg[p] || j - 1 > dp.end[p]) continue;
-                if (dp.h(p, j - 1) + s == Hij) {
+                if (dp.h(p, j - 1) + s + ps == Hij) {
                     cig.push(0, 1, nid, j - 1);
                     i = p; --j; nid = g.index_to_node_id[i + beg_index];
                     cur_op = 0x1F;
@@ -1067,9 +1125,11 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
 
         if (!hit) {  // deletion
             if (linear) {
-                for (int p : pre[i]) {
+                for (int32_t t = pre_off[i]; t < pre_off[i + 1]; ++t) {
+                    const int p = pre_flat[t];
+                    const int32_t ps = inc_ps ? pre_ps[t] : 0;
                     if (j < dp.beg[p] || j > dp.end[p]) continue;
-                    if (dp.h(p, j) - e1 == Hij) {
+                    if (dp.h(p, j) - e1 + ps == Hij) {
                         cig.push(2, 1, nid, j - 1);
                         i = p; nid = g.index_to_node_id[i + beg_index];
                         hit = true; look_gap = 0;
@@ -1077,13 +1137,15 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                     }
                 }
             } else if (cur_op & (E1_OP | E2_OP)) {
-                for (int p : pre[i]) {
+                for (int32_t t = pre_off[i]; t < pre_off[i + 1]; ++t) {
+                    const int p = pre_flat[t];
+                    const int32_t ps = inc_ps ? pre_ps[t] : 0;
                     if (j < dp.beg[p] || j > dp.end[p]) continue;
                     bool done = false;
                     if (cur_op & E1_OP) {
                         bool cond = (cur_op & M_OP)
-                            ? (Hij == dp.e1(p, j))
-                            : (dp.e1(i, j) == dp.e1(p, j) - e1);
+                            ? (Hij == dp.e1(p, j) + ps)
+                            : (dp.e1(i, j) == dp.e1(p, j) - e1 + ps);
                         if (cond) {
                             cur_op = (dp.h(p, j) - oe1 == dp.e1(p, j))
                                 ? (M_OP | F1_OP | F2_OP) : E1_OP;
@@ -1094,8 +1156,8 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                     }
                     if (!done && convex && (cur_op & E2_OP)) {
                         bool cond = (cur_op & M_OP)
-                            ? (Hij == dp.e2(p, j))
-                            : (dp.e2(i, j) == dp.e2(p, j) - e2);
+                            ? (Hij == dp.e2(p, j) + ps)
+                            : (dp.e2(i, j) == dp.e2(p, j) - e2 + ps);
                         if (cond) {
                             cur_op = (dp.h(p, j) - oe2 == dp.e2(p, j))
                                 ? (M_OP | F1_OP | F2_OP) : E2_OP;

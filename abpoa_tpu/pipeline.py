@@ -119,9 +119,12 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
 
 def _want_native(abpt: Params) -> bool:
     # native host core pairs with the device kernel; the numpy oracle reads
-    # Python Node objects directly, and the oracle-only corner flag needs it
+    # Python Node objects directly
     if abpt.device == "native":
-        return not abpt.inc_path_score
+        return True
+    # device paths with a native host graph: -G needs per-edge path scores
+    # the jax table builder only derives from Python graphs
+    # (jax_backend.py:306), so those configs keep the Python graph
     return (abpt.device in ("jax", "tpu", "pallas")
             and not abpt.inc_path_score and abpt.zdrop <= 0)
 

@@ -63,3 +63,26 @@ def run_stats(t0: float, c0: float) -> str:
     return (f"Real time: {time.time() - t0:.3f} sec; "
             f"CPU: {time.process_time() - c0:.3f} sec; "
             f"Peak RSS: {peak_rss_gb():.3f} GB.")
+
+
+def dump_dp_matrix(H, dp_beg, dp_end, index_to_node_id, beg_index,
+                   planes=None, max_rows: int = 0) -> None:
+    """`-V3` DP-matrix dump for kernel debugging: per row, the in-band H
+    (and optionally E/F) cells with their absolute columns — the analog of
+    the reference's __SIMD_DEBUG__ print path
+    (/root/reference/src/abpoa_align_simd.c:46-95). Gated on
+    VERBOSE_LONG_DEBUG so production runs never pay the host sync."""
+    if _VERBOSE < C.VERBOSE_LONG_DEBUG:
+        return
+    n = H.shape[0] if max_rows <= 0 else min(max_rows, H.shape[0])
+    for i in range(n):
+        b, e = int(dp_beg[i]), int(dp_end[i])
+        nid = int(index_to_node_id[beg_index + i])
+        cells = " ".join(f"{j}:{int(H[i, j])}" for j in range(b, e + 1))
+        print(f"[abpoa_tpu::dp] row {i} (node {nid}) band [{b},{e}] "
+              f"H: {cells}", file=sys.stderr)
+        if planes:
+            for name, P in planes.items():
+                cells = " ".join(f"{j}:{int(P[i, j])}"
+                                 for j in range(b, e + 1))
+                print(f"[abpoa_tpu::dp]   {name}: {cells}", file=sys.stderr)

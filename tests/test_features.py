@@ -91,3 +91,25 @@ def test_rc_mixed_strand_seeded():
 def test_rc_mixed_strand_seeded_progressive():
     got = run_cli([os.path.join(DATA_DIR, "rcmix.fa"), "-s", "-S", "-p", "-n", "200"])
     assert got == golden("rcmix_sSp.txt")
+
+
+def test_v3_dp_matrix_dump():
+    """-V3 dumps the banded DP matrix for kernel debugging (the reference's
+    __SIMD_DEBUG__ path, src/abpoa_align_simd.c:46-95; SURVEY §5) without
+    changing stdout."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(DATA_DIR, "test.fa")
+    base = subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", "--device", "numpy", path],
+        capture_output=True, text=True, timeout=300, cwd=root)
+    v3 = subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", "--device", "numpy", "-V3",
+         path],
+        capture_output=True, text=True, timeout=300, cwd=root)
+    assert v3.returncode == 0
+    assert v3.stdout == base.stdout
+    assert "[abpoa_tpu::dp] row 0" in v3.stderr
+    assert "H:" in v3.stderr
+    assert "[abpoa_tpu::dp]" not in base.stderr

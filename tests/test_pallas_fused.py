@@ -151,3 +151,26 @@ def test_pallas_fused_compiled_on_chip(plane16, gap_kw):
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, timeout=900)
     assert "PARITY-OK" in proc.stdout, proc.stderr[-2000:]
+
+
+@pytest.mark.parametrize("extra", [
+    {"align_mode": 2},
+    {"align_mode": 2, "zdrop": 20},
+], ids=["extend", "extend-zdrop"])
+def test_pallas_fused_matches_scan_extend(extra):
+    """Extend mode (+Z-drop) through the Pallas kernel: best-cell/Z-drop
+    bookkeeping lives in SMEM scalars (set_extend_max_score,
+    abpoa_align_simd.c:1076-1090); parity vs the XLA scan."""
+    _parity_subproc("seq.fa", extra, True)
+
+
+@pytest.mark.skipif(not _accelerator_reachable(),
+                    reason="no accelerator reachable (wedged tunnel or CPU-only)")
+def test_pallas_fused_extend_compiled_on_chip():
+    """Compiled extend+Z-drop parity on the real accelerator (the SMEM
+    best-state variant must lower on Mosaic, not just in interpret mode)."""
+    code = _parity_child_code("seq.fa", {"align_mode": 2, "zdrop": 20},
+                              force_int32=True, pin_cpu=False)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=900)
+    assert "PARITY-OK" in proc.stdout, proc.stderr[-2000:]

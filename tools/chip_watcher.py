@@ -164,7 +164,10 @@ def main():
             log_probe("alive" if alive else "wedged", platform=plat)
             was_alive = alive
         if not alive:
-            time.sleep(120)
+            # each probe burns a cold jax import (~20-40 s CPU on this
+            # 1-core host); a longer sleep keeps the watcher's duty cycle
+            # low so foreground builds/benches stay clean
+            time.sleep(240)
             continue
         pending = [s for s in STEPS if s[0] not in st["done"]]
         if not pending:

@@ -112,7 +112,7 @@ def task_pallas(R: int, W: int, unroll_k: int, plane16: bool,
         return out
 
     out = run()
-    ok = int(out[-1][0])
+    ok = int(out[7][0])
     walls = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -179,6 +179,11 @@ def main():
     a = ap.parse_args()
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(HERE, ".jax_cache"))
+    if a.interpret:
+        # CPU validation runs: the env var loses the platform race against
+        # the site hook's device plugin; the config-level pin wins
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     if a.task == "floor":
         task_floor(a.iters)
     elif a.task == "pallas":
