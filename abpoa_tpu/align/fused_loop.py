@@ -183,14 +183,19 @@ def _remain_doubling(g: DeviceGraph) -> jnp.ndarray:
 # --------------------------------------------------------------------------- #
 
 def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf,
-                 gap_mode: int = C.CONVEX_GAP):
+                 gap_mode: int = C.CONVEX_GAP, local: bool = False):
     """Row-0 (source row) plane windows per gap regime
     (abpoa_align_simd.c:582-688). Single source of truth — used by both
-    _dp_banded's init and the Pallas path. Dtype follows the scalars."""
+    _dp_banded's init and the Pallas path. Dtype follows the scalars.
+    Local mode zero-fills every plane across the (full-width) band
+    (oracle.py:178-185; reference first-row local init)."""
     dt = jnp.asarray(o1).dtype
     kw = jnp.arange(W, dtype=jnp.int32)
     kw_dt = kw.astype(dt)
     colv = kw <= dp_end0
+    if local:
+        z = jnp.where(colv, jnp.zeros(W, dt), inf)
+        return z, z, z, z, z
     if gap_mode == C.LINEAR_GAP:
         H0 = jnp.where(colv, -e1 * kw_dt, inf)
         E10 = E20 = F10 = F20 = jnp.full(W, inf, dt)
@@ -212,24 +217,28 @@ def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf,
     return H0, E10, E20, F10, F20
 
 @functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16",
-                                              "extend", "zdrop_on"))
+                                              "extend", "zdrop_on", "local"))
 def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                remain_rows, mpl0, mpr0, qp, n_rows,
                qlen, w, remain_end, inf_min, dp_end0,
                o1, e1, oe1, o2, e2, oe2,
                gap_mode: int, W: int, plane16: bool = False,
-               extend: bool = False, zdrop_on: bool = False, zdrop=0):
+               extend: bool = False, zdrop_on: bool = False, zdrop=0,
+               local: bool = False):
     """Adaptive-banded DP with W-wide windowed plane storage.
 
     Row i stores plane cells for absolute columns [dp_beg[i], dp_beg[i]+W);
     in-band cells outside [dp_beg, dp_end] and window cells past dp_end are
     -inf, matching the reference full-width semantics
     (/root/reference/src/abpoa_align_simd.c:935-1074, band macros
-    src/abpoa_align.h:34-35). Global and extend modes; extend tracks the
-    running best cell with optional Z-drop termination
+    src/abpoa_align.h:34-35). Global, extend, and local modes; extend tracks
+    the running best cell with optional Z-drop termination
     (set_extend_max_score, abpoa_align_simd.c:1082-1090) in int32 scalar
     bookkeeping regardless of plane width, like the reference's scalar
-    best-score variables.
+    best-score variables. Local mode (reference: banding disabled,
+    abpoa_post_set_para) runs full-width rows [0, qlen] with cells clamped
+    at 0, the M lead treated as 0, and the best (leftmost, earliest-row)
+    max-anywhere cell tracked in the same scalar slots.
 
     Returns (H, E1, E2, F1, F2, dp_beg, dp_end, mpl, mpr, band_overflow,
     best_score, best_i, best_j).
@@ -251,7 +260,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     # ---- first row: absolute cols [0, dp_end0] ------------------------------
     # single source of truth shared with the Pallas caller (_row0_planes)
     H0, E10, E20, F10, F20 = _row0_planes(
-        W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf, gap_mode=gap_mode)
+        W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf, gap_mode=gap_mode,
+        local=local)
 
     Hb = jnp.full((R, W), inf, dt).at[0].set(H0)
     E1b = jnp.full((R, W), inf, dt).at[0].set(E10)
@@ -337,14 +347,21 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             pidx = pre_idx[i]
 
             # ---- band ------------------------------------------------------
-            r = qlen - (remain_rows[i] - remain_end - 1)
-            beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
-            end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
-            pb = dp_beg[pidx]
-            for s in range(t):
-                pb = jnp.where(pidx == i0 + s, lbeg[s], pb)
-            min_pre_beg = jnp.min(jnp.where(pm, pb, jnp.int32(2**30)))
-            beg = jnp.maximum(beg, min_pre_beg)
+            if local:
+                # local mode disables banding (abpoa_post_set_para): every
+                # row covers the full query, [0, qlen]
+                beg = jnp.int32(0)
+                end = qlen
+                pb = jnp.zeros_like(dp_beg[pidx])
+            else:
+                r = qlen - (remain_rows[i] - remain_end - 1)
+                beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
+                end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
+                pb = dp_beg[pidx]
+                for s in range(t):
+                    pb = jnp.where(pidx == i0 + s, lbeg[s], pb)
+                min_pre_beg = jnp.min(jnp.where(pm, pb, jnp.int32(2**30)))
+                beg = jnp.maximum(beg, min_pre_beg)
             overflow = overflow | (active & (end - beg + 1 > W))
             abs_cols = beg + kw
             in_band = abs_cols <= end
@@ -355,6 +372,10 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             Hm1 = overlay(pre_window(Hb, pidx, pm, pb, abs_cols - 1, inf),
                           lH, pidx, pm, i0, t, lbeg, abs_cols - 1, inf)
             Mq = jnp.max(Hm1, axis=0)
+            if local:
+                # the lead cell (absolute col -1) counts as 0 in local mode
+                # (oracle.py lead; reference local first-col semantics)
+                Mq = jnp.where(abs_cols == 0, jnp.maximum(Mq, 0), Mq)
             if linear:
                 Hj = overlay(pre_window(Hb, pidx, pm, pb, abs_cols, inf),
                              lH, pidx, pm, i0, t, lbeg, abs_cols, inf)
@@ -379,6 +400,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
 
             if linear:
                 Hrow = chain_max(Hhat, e1)
+                if local:
+                    Hrow = jnp.maximum(Hrow, 0)
                 Hrow = jnp.where(in_band, Hrow, inf)
                 E1n = E2n = F1n = F2n = jnp.full(W, inf, dt)
             else:
@@ -394,13 +417,22 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                     Hrow = jnp.maximum(Hrow, F2n)
                 else:
                     F2n = jnp.full(W, inf, dt)
+                if local:
+                    # local clamp BEFORE deriving E (oracle.py:298-311): the
+                    # E recursion reads the clamped H
+                    Hrow = jnp.maximum(Hrow, 0)
                 if gap_mode == C.AFFINE_GAP:
                     E1n = jnp.maximum(Erow - e1, Hrow - oe1)
-                    E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                    # local: the killed-E value is 0, not -inf (oracle "dead")
+                    E1n = jnp.where(Hrow == Hhat, E1n,
+                                    jnp.zeros(W, dt) if local else inf)
                     E2n = jnp.full(W, inf, dt)
                 else:
                     E1n = jnp.maximum(Erow - e1, Hrow - oe1)
                     E2n = jnp.maximum(E2row - e2, Hrow - oe2)
+                    if local:
+                        E1n = jnp.maximum(E1n, 0)
+                        E2n = jnp.maximum(E2n, 0)
                 E1n = jnp.where(in_band, E1n, inf)
                 E2n = jnp.where(in_band, E2n, inf)
                 F1n = jnp.where(in_band, F1n, inf)
@@ -415,6 +447,14 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             left = jnp.where(has, beg + jnp.argmax(eq), -1).astype(jnp.int32)
             right = jnp.where(has, beg + W - 1 - jnp.argmax(eq[::-1]),
                               -1).astype(jnp.int32)
+            if local:
+                # best-anywhere cell, leftmost column, earliest row on ties
+                # (oracle.py:336-338; reference local argmax tracking)
+                mx32 = mx.astype(jnp.int32)
+                better = active & (mx32 > bs)
+                bs = jnp.where(better, mx32, bs)
+                bi = jnp.where(better, i, bi)
+                bj = jnp.where(better, left, bj)
             if extend:
                 mx32 = mx.astype(jnp.int32)
                 has_row = mx > inf
@@ -433,10 +473,11 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 bi = jnp.where(better, i, bi)
                 bj = jnp.where(better, right, bj)
                 brem = jnp.where(better, remain_rows[i], brem)
-            om = out_msk[i] & active & (~zdropped)
-            tgt = jnp.where(om, out_idx[i], R)
-            mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
-            mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
+            if not local:  # local bypasses the band formula entirely
+                om = out_msk[i] & active & (~zdropped)
+                tgt = jnp.where(om, out_idx[i], R)
+                mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
+                mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
 
             # ---- local commit (inactive rows write discarded padding) ------
             lH.append(jnp.where(active, Hrow, inf))
@@ -484,13 +525,14 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
 # --------------------------------------------------------------------------- #
 
 @functools.partial(jax.jit, static_argnames=(
-    "gap_mode", "gap_on_right", "put_gap_at_end", "max_ops"))
+    "gap_mode", "gap_on_right", "put_gap_at_end", "max_ops", "local"))
 def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
                  base_r, query_pad, mat, best_i, best_j,
                  e1, oe1, e2, oe2, inf_min,
                  gap_mode: int, gap_on_right: bool, put_gap_at_end: bool,
-                 max_ops: int):
-    """Backtrack over windowed planes (global mode).
+                 max_ops: int, local: bool = False):
+    """Backtrack over windowed planes (global/extend; local stops at H == 0,
+    oracle.py:411-412).
 
     Mirrors jax_backtrack.device_backtrack but indexes plane cell (i, j) at
     window position j - dp_beg[i]; out-of-window cells read as -inf, which is
@@ -533,6 +575,12 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
         # below are clamp-safe for any (i, j)
         c = (i > 0) & (j > 0) & (~err) & (~done)
         H_ij = gat(H, i, j)
+        if local:
+            # a zero cell ends the local walk BEFORE emitting any op
+            stop = c & (H_ij == 0)
+            c = c & (~stop)
+        else:
+            stop = jnp.bool_(False)
         s = mat[base_r[i], query_pad[j - 1]]
         is_match = (base_r[i] == query_pad[j - 1]).astype(i32)
 
@@ -651,7 +699,7 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
                 jnp.where(adv, new_op, cur_op), jnp.where(adv, new_look, look_gap),
                 n_ops + jnp.where(adv, 1, 0), ops,
                 jnp.where(adv, new_naln, n_aln), jnp.where(adv, new_nmatch, n_match),
-                err | (c & (no_hit | cap)), done)
+                err | (c & (no_hit | cap)), done | stop)
 
     def body(st):
         for _ in range(BT_UNROLL):
@@ -1000,7 +1048,7 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
     "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths",
-    "amb_strand", "extend", "zdrop_on"))
+    "amb_strand", "extend", "zdrop_on", "local"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
@@ -1012,7 +1060,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     record_paths: bool = False,
                     amb_strand: bool = False,
                     extend: bool = False, zdrop_on: bool = False,
-                    zdrop=0) -> FusedState:
+                    zdrop=0, local: bool = False) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -1051,7 +1099,10 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
             w = w_scalar_b + jnp.int32(w_scalar_f * qlen)
             remain_end = remain[C.SINK_NODE_ID]
             r0 = qlen - (remain_rows[0] - remain_end - 1)
-            dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w)
+            if local:  # unbanded: the source row spans the whole query
+                dp_end0 = qlen
+            else:
+                dp_end0 = jnp.minimum(qlen, jnp.maximum(mpr0[0], r0) + w)
             tt = jnp.arange(max_ops, dtype=jnp.int32)
 
             def align_strand(query_s, qp_s):
@@ -1066,15 +1117,15 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         qlen, w, remain_end, inf_min, dp_end0,
                         o1, e1, oe1, o2, e2, oe2, gap_mode=gap_mode, W=W,
                         plane16=plane16, extend=extend, zdrop_on=zdrop_on,
-                        zdrop=zdrop)
+                        zdrop=zdrop, local=local)
 
                 if use_pallas:
                     # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
                     # back in-jit to the XLA scan on ring/band overflow
                     # (measured rate on sim10k graphs: 0.0%, PERF.md). Covers
-                    # all three gap regimes, both plane widths, and both
-                    # fused-eligible align modes (global + extend/Z-drop,
-                    # tracked in SMEM scalars).
+                    # all three gap regimes, both plane widths, and all three
+                    # align modes (global; extend/Z-drop and local best-cell
+                    # state tracked in SMEM scalars).
                     from .pallas_fused import pallas_fused_dp
                     dtp = jnp.int16 if plane16 else jnp.int32
                     N_, E_ = pre_idx.shape
@@ -1087,7 +1138,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     H0, E10, E20, F10, F20 = _row0_planes(
                         W, dp_end0, o1.astype(dtp), e1.astype(dtp),
                         oe1.astype(dtp), o2.astype(dtp), e2.astype(dtp),
-                        oe2.astype(dtp), infp, gap_mode=gap_mode)
+                        oe2.astype(dtp), infp, gap_mode=gap_mode, local=local)
                     row0H, row0E1, row0E2 = H0[None], E10[None], E20[None]
                     qp_padW = jnp.pad(qp_s, ((0, 0), (0, W)))
                     sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1,
@@ -1099,7 +1150,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         remain_rows, row0H, row0E1, row0E2, qp_padW,
                         R=N_, W=W, P=E_, O=E_, gap_mode=gap_mode,
                         plane16=plane16, extend=extend, zdrop_on=zdrop_on,
-                        interpret=pl_interpret)
+                        local=local, interpret=pl_interpret)
                     # the kernel writes rows 1..: patch the source row in
                     end_p = end_p.at[0].set(dp_end0)
                     beg_p = beg_p.at[0].set(0)
@@ -1119,9 +1170,10 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
                      overflow, ext_sc, ext_i, ext_j) = dp_scan_path(None)
 
-                if extend:
-                    # extend mode ends at the tracked best cell
-                    # (set_extend_max_score, abpoa_align_simd.c:1082-1090)
+                if extend or local:
+                    # extend/local end at the tracked best cell (extend:
+                    # set_extend_max_score, abpoa_align_simd.c:1082-1090;
+                    # local: max-anywhere, leftmost/earliest)
                     best_i, best_j, best_sc = ext_i, ext_j, ext_sc
                 else:
                     # global best over the sink's pred rows at their band ends
@@ -1145,7 +1197,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     base_r, query_s, mat, best_i, best_j,
                     e1, oe1, e2, oe2, inf_min,
                     gap_mode=gap_mode, gap_on_right=gap_on_right,
-                    put_gap_at_end=put_gap_at_end, max_ops=max_ops)
+                    put_gap_at_end=put_gap_at_end, max_ops=max_ops,
+                    local=local)
 
                 # reverse into forward order (+ head/tail INS for the ends)
                 head = fin_j
@@ -1351,10 +1404,14 @@ def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
 
 
 def fused_eligible(abpt: Params, n_seq: int) -> bool:
-    """The fused device loop covers the reference's default progressive-POA
-    configuration; other modes use the per-alignment backends."""
-    return (abpt.align_mode in (C.GLOBAL_MODE, C.EXTEND_MODE)
-            and abpt.wb >= 0
+    """The fused device loop covers the reference's progressive-POA
+    configurations in all three align modes (global banded, extend with
+    Z-drop, local unbanded); remaining corners (-G path scores, qv-weighted
+    multi-consensus, restored-graph read-id outputs) use the per-alignment
+    backends."""
+    return ((abpt.align_mode == C.LOCAL_MODE  # unbanded by definition
+             or (abpt.align_mode in (C.GLOBAL_MODE, C.EXTEND_MODE)
+                 and abpt.wb >= 0))
             and not abpt.inc_path_score
             and not (abpt.use_qv and abpt.max_n_cons > 1)
             and not (abpt.incr_fn and abpt.use_read_ids)
@@ -1435,8 +1492,13 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     n_reads = len(seqs)
     qmax = max(len(s) for s in seqs)
     Qp = _bucket(qmax + 2, 128)
-    w_full = abpt.wb + int(abpt.wf * qmax)
-    W = max(128, _bucket_pow2(2 * w_full + 4))
+    local_m = abpt.align_mode == C.LOCAL_MODE
+    if local_m:
+        # local disables banding: every row spans the full query
+        W = max(128, _bucket_pow2(qmax + 2))
+    else:
+        w_full = abpt.wb + int(abpt.wf * qmax)
+        W = max(128, _bucket_pow2(2 * w_full + 4))
     n0 = 0
     E = 8
     A = 8
@@ -1500,10 +1562,16 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                                  n_reads=n_reads if record_paths else 1,
                                  Pcap=Qp + 2 if record_paths else 8,
                                  n_rc=n_reads if amb else 1)
+    if use_pallas:
+        from .pallas_fused import fits_vmem
     kahn_total = 0
     for _ in range(max_chunks):
         max_ops = N + Qp + 8
         inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+        # static VMEM guard: local mode (and band growth) can push W past
+        # what the kernel's rings fit; those compiles take the XLA scan
+        up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
+                                      m=abpt.m, Qp=Qp)
         state = run_fused_chunk(
             state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
             qp_d, mat_d, jnp.int32(abpt.wb), jnp.float32(abpt.wf),
@@ -1516,10 +1584,10 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             put_gap_at_end=bool(abpt.put_gap_at_end),
             plane16=plane16, max_mat=int(abpt.max_mat),
             int16_limit=int(int16_limit),
-            use_pallas=bool(use_pallas),
+            use_pallas=bool(up),
             pl_interpret=pl_interpret, record_paths=record_paths,
             amb_strand=amb, extend=extend_m, zdrop_on=zdrop_on,
-            zdrop=jnp.int32(max(abpt.zdrop, 0)))
+            zdrop=jnp.int32(max(abpt.zdrop, 0)), local=local_m)
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:

@@ -19,7 +19,9 @@ Differences from pallas_kernel.py (the per-alignment prototype):
   them); mpl/mpr are NOT output — the fused loop rebuilds adaptive-band
   state from the graph each read, matching the reference's re-init in
   abpoa_topological_sort;
-- covers all three gap regimes (linear/affine/convex, global banded) and
+- covers all three gap regimes (linear/affine/convex), all three align
+  modes (global banded; extend with Z-drop and local with best-anywhere
+  tracking, both in SMEM scalars) and
   both plane widths (int16 while the reference promotion bound allows,
   int32 after — /root/reference/src/abpoa_align_simd.c:1293-1302). All
   in-kernel math runs in int32 (i16 vector ops do not legalize on Mosaic;
@@ -55,7 +57,8 @@ _M_BASE, _M_NPRE, _M_NOUT, _M_REMAIN, _M_TAB = 0, 1, 2, 3, 4
 
 
 def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
-                 K: int, extend: bool = False, zdrop_on: bool = False):
+                 K: int, extend: bool = False, zdrop_on: bool = False,
+                 local: bool = False):
     linear = gap_mode == C.LINEAR_GAP
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
@@ -65,9 +68,10 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
     def kernel(sc_ref, meta_ref, row0H_ref, row0E1_ref, row0E2_ref, qp_ref,
                H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
                ok_out, ext_out, *scratch):
-        if extend:
-            # best-cell tracking state (set_extend_max_score,
-            # src/abpoa_align_simd.c:1082-1090): [bs, bi, bj, brem, zdropped]
+        if extend or local:
+            # best-cell tracking state (extend: set_extend_max_score,
+            # src/abpoa_align_simd.c:1082-1090; local: max-anywhere,
+            # leftmost/earliest): [bs, bi, bj, brem, zdropped]
             best_s = scratch[-1]
             scratch = scratch[:-1]
         if plane16:
@@ -104,7 +108,7 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
         @pl.when(g == 0)
         def _init():
             ok_s[0] = jnp.where(end0 + 1 > W, 0, 1)
-            if extend:
+            if extend or local:
                 best_s[0] = inf
                 best_s[1] = 0
                 best_s[2] = 0
@@ -177,19 +181,24 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
 
             @pl.when(active)
             def _row():
-                r = qlen - (smeta[sub, _M_REMAIN] - remain_end - 1)
-                mpl_v = mpl_s[row % D]
-                mpr_v = mpr_s[row % D]
-                beg = jnp.maximum(0, jnp.minimum(mpl_v, r) - w)
-                end = jnp.minimum(qlen, jnp.maximum(mpr_v, r) + w)
                 npre = smeta[sub, _M_NPRE]
                 nout = smeta[sub, _M_NOUT]
+                if local:
+                    # local mode disables banding: full-width rows [0, qlen]
+                    beg = jnp.int32(0)
+                    end = qlen
+                else:
+                    r = qlen - (smeta[sub, _M_REMAIN] - remain_end - 1)
+                    mpl_v = mpl_s[row % D]
+                    mpr_v = mpr_s[row % D]
+                    beg = jnp.maximum(0, jnp.minimum(mpl_v, r) - w)
+                    end = jnp.minimum(qlen, jnp.maximum(mpr_v, r) + w)
 
-                def mpb(k, acc):
-                    p = smeta[sub, _M_TAB + k]
-                    return jnp.minimum(acc, beg_s[p % D])
-                min_pre_beg = lax.fori_loop(0, npre, mpb, jnp.int32(2**30))
-                beg = jnp.maximum(beg, min_pre_beg)
+                    def mpb(k, acc):
+                        p = smeta[sub, _M_TAB + k]
+                        return jnp.minimum(acc, beg_s[p % D])
+                    min_pre_beg = lax.fori_loop(0, npre, mpb, jnp.int32(2**30))
+                    beg = jnp.maximum(beg, min_pre_beg)
 
                 # overflow: band wider than W, pred outside the ring, or a
                 # successor further than the ring can scatter
@@ -236,6 +245,9 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                 Mq, E1r, E2r = lax.fori_loop(
                     0, npre, pred_body, (neg_row, neg_row, neg_row))
 
+                if local:
+                    # the lead cell (absolute col -1) counts as 0
+                    Mq = jnp.where(cols == 0, jnp.maximum(Mq, 0), Mq)
                 qprow = qp_band_row(qp_ref, base_v, beg, W)
                 Mq = jnp.where(in_band, Mq + qprow, inf)
 
@@ -246,7 +258,10 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                     # simd_abpoa_lg_dp :727-815)
                     Erow = jnp.where(in_band, E1r - e1, inf)
                     Hhat = jnp.maximum(Mq, Erow)
-                    Hrow = jnp.where(in_band, chain(Hhat, sc_ref[4]), inf)
+                    Hrow = chain(Hhat, sc_ref[4])
+                    if local:
+                        Hrow = jnp.maximum(Hrow, 0)
+                    Hrow = jnp.where(in_band, Hrow, inf)
                     E1n = E2n = F1 = F2 = neg_row
                 else:
                     E1r = jnp.where(in_band, E1r, inf)
@@ -266,14 +281,24 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                                                  Hm1 - oe2), inf)
                         F2 = chain(A2, sc_ref[6])
                         Hrow = jnp.maximum(Hrow, F2)
+                        if local:  # clamp BEFORE deriving E (oracle order)
+                            Hrow = jnp.maximum(Hrow, 0)
                         E1n = jnp.maximum(E1r - e1, Hrow - oe1)
                         E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+                        if local:
+                            E1n = jnp.maximum(E1n, 0)
+                            E2n = jnp.maximum(E2n, 0)
                     else:
                         F2 = neg_row
+                        if local:
+                            Hrow = jnp.maximum(Hrow, 0)
                         # ag regime gates E on H == Hhat (reference
-                        # simd_abpoa_ag_dp :817-933; affine branch)
+                        # simd_abpoa_ag_dp :817-933; affine branch); the
+                        # killed-E value is 0 in local mode
                         E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-                        E1n = jnp.where(Hrow == Hhat, E1n, inf)
+                        E1n = jnp.where(Hrow == Hhat, E1n,
+                                        jnp.zeros((1, W), jnp.int32)
+                                        if local else inf)
                         E2n = neg_row
                     Hrow = jnp.where(in_band, Hrow, inf)
                     E1n = jnp.where(in_band, E1n, inf)
@@ -300,6 +325,14 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                 left, right, mx, has_row = band_extents(Hrow, in_band, cols,
                                                         sc_ref[3])
 
+                if local:
+                    # best-anywhere cell: leftmost column, earliest row on
+                    # ties (strict >), mirroring _dp_banded's local branch
+                    bs = best_s[0]
+                    better = mx > bs
+                    best_s[0] = jnp.where(better, mx, bs)
+                    best_s[1] = jnp.where(better, row, best_s[1])
+                    best_s[2] = jnp.where(better, left, best_s[2])
                 if extend:
                     # sequential best/Z-drop bookkeeping in SMEM scalars,
                     # mirroring _dp_banded's extend branch row for row. Rows
@@ -323,27 +356,28 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                     best_s[2] = jnp.where(better, right, bj)
                     best_s[3] = jnp.where(better, rrem, brem)
 
-                def out_body(k, _):
-                    t = smeta[sub, _M_TAB + P + k]
-                    mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
-                    mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
-                    return 0
+                if not local:  # local bypasses the band formula entirely
+                    def out_body(k, _):
+                        t = smeta[sub, _M_TAB + P + k]
+                        mpr_s[t % D] = jnp.maximum(mpr_s[t % D], right + 1)
+                        mpl_s[t % D] = jnp.minimum(mpl_s[t % D], left + 1)
+                        return 0
 
-                if extend and zdrop_on:
-                    # the scan gates the scatter on the POST-update flag
-                    # (a row that trips Z-drop does not scatter)
-                    @pl.when(best_s[4] == 0)
-                    def _scatter():
+                    if extend and zdrop_on:
+                        # the scan gates the scatter on the POST-update flag
+                        # (a row that trips Z-drop does not scatter)
+                        @pl.when(best_s[4] == 0)
+                        def _scatter():
+                            lax.fori_loop(0, nout, out_body, 0)
+                    else:
                         lax.fori_loop(0, nout, out_body, 0)
-                else:
-                    lax.fori_loop(0, nout, out_body, 0)
 
-                # this row's mpl/mpr ring slot now belongs to row+D: reset
-                # it AFTER all reads/writes of row's own value (successors
-                # of rows < row have already scattered; writers to row+D
-                # are rows > row, which run later)
-                mpl_s[row % D] = gn
-                mpr_s[row % D] = 0
+                    # this row's mpl/mpr ring slot now belongs to row+D:
+                    # reset it AFTER all reads/writes of row's own value
+                    # (successors of rows < row have already scattered;
+                    # writers to row+D are rows > row, which run later)
+                    mpl_s[row % D] = gn
+                    mpr_s[row % D] = 0
 
             @pl.when(~active)
             def _pad():
@@ -371,7 +405,7 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
         @pl.when(g == n_steps - 1)
         def _flush():
             ok_out[0] = ok_s[0]
-            if extend:
+            if extend or local:
                 ext_out[0] = best_s[0]
                 ext_out[1] = best_s[1]
                 ext_out[2] = best_s[2]
@@ -390,18 +424,34 @@ def meta_lanes(P: int, O: int) -> int:
     return -(-(_M_TAB + P + O) // 128) * 128
 
 
+def fits_vmem(W: int, gap_mode: int, plane16: bool,
+              m: int = 32, Qp: int = 0) -> bool:
+    """Static check that the kernel's VMEM working set (rings + streamed
+    blocks + the fully-resident (m, Qp+W) query profile) fits the ~16 MB
+    budget with headroom. Local mode's full-width rows can push W to the
+    query length; callers fall back to the XLA scan when it would not fit.
+    The (BLOCK_B, meta_lanes) metadata block is KBs — ignored."""
+    rings = {C.LINEAR_GAP: 1, C.AFFINE_GAP: 2, C.CONVEX_GAP: 3}[gap_mode]
+    ring_bytes = rings * RING_D * W * 4
+    # 5 plane output blocks, double-buffered, plus i32 staging for int16
+    blk_bytes = (5 * 2 + (5 if plane16 else 0)) * BLOCK_B * W * 4
+    qp_bytes = m * (Qp + W) * 4
+    return ring_bytes + blk_bytes + qp_bytes <= 11 * 2**20
+
+
 @functools.partial(jax.jit, static_argnames=(
     "R", "W", "P", "O", "gap_mode", "plane16", "extend", "zdrop_on",
-    "interpret"))
+    "local", "interpret"))
 def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
                     remain_rows, row0H, row0E1, row0E2, qp_pad,
                     R: int, W: int, P: int, O: int,
                     gap_mode: int = C.CONVEX_GAP, plane16: bool = False,
                     extend: bool = False, zdrop_on: bool = False,
-                    interpret: bool = False):
-    """Banded forward DP for the fused loop (all gap regimes; global and
-    extend modes, extend with optional Z-drop — set_extend_max_score,
-    src/abpoa_align_simd.c:1076-1090).
+                    local: bool = False, interpret: bool = False):
+    """Banded forward DP for the fused loop (all gap regimes; global,
+    extend with optional Z-drop — set_extend_max_score,
+    src/abpoa_align_simd.c:1076-1090 — and local mode: full-width rows,
+    0-clamped cells, best-anywhere cell in the ext output).
 
     base_packed: base | (is_src_out << 8) per row. qp_pad: (m, Qp + W) int32.
     row0*: (1, W) plane dtype (widened to int32 internally). scalars: (16,)
@@ -420,7 +470,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
     convex = gap_mode == C.CONVEX_GAP
     dt = jnp.int16 if plane16 else jnp.int32
     kernel = _make_kernel(W, P, O, D, gap_mode, plane16, K,
-                          extend=extend, zdrop_on=zdrop_on)
+                          extend=extend, zdrop_on=zdrop_on, local=local)
     m = qp_pad.shape[0]
     L = meta_lanes(P, O)
     meta = jnp.concatenate(
@@ -471,7 +521,7 @@ def pallas_fused_dp(scalars, base_packed, pre_idx, pre_cnt, out_idx, out_cnt,
     if plane16:
         # i32 staging blocks for the five plane outputs (see kernel)
         scratch += [pltpu.VMEM((B, W), jnp.int32)] * 5
-    if extend:
+    if extend or local:
         scratch.append(pltpu.SMEM((5,), jnp.int32))  # best-cell state
     fn = pl.pallas_call(
         kernel,

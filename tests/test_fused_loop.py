@@ -271,3 +271,23 @@ def test_fused_extend_zdrop(flags):
     if flags == ["-m2"]:
         with open(os.path.join(GOLDEN_DIR, "seq_m2.txt")) as fp:
             assert got == fp.read()
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                    # convex
+    {"gap_open2": 0},                      # affine
+    {"gap_open1": 0, "gap_open2": 0},      # linear
+], ids=["convex", "affine", "linear"])
+def test_fused_local_mode(kw):
+    """Local mode (-m1) through the fused device loop: unbanded full-width
+    rows with 0-clamp, best-anywhere (leftmost/earliest) cell, backtrack
+    stopping at H == 0 (reference: local clamp abpoa_align_simd.c:1060-1072,
+    banding disabled in abpoa_post_set_para); byte parity with the numpy
+    oracle and the frozen -m1 golden."""
+    path = os.path.join(DATA_DIR, "seq.fa")
+    got, _ = _consensus_via_fused(path, align_mode=1, **kw)
+    want = _consensus_via_host(path, align_mode=1, **kw)
+    assert got == want
+    if not kw:
+        with open(os.path.join(GOLDEN_DIR, "seq_m1.txt")) as fp:
+            assert got == fp.read()

@@ -174,3 +174,22 @@ def test_pallas_fused_extend_compiled_on_chip():
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, timeout=900)
     assert "PARITY-OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_pallas_fused_matches_scan_local():
+    """Local mode (-m1) through the Pallas kernel: full-width rows, 0-clamped
+    cells, best-anywhere cell tracked in the SMEM scalars; parity vs the XLA
+    scan."""
+    _parity_subproc("seq.fa", {"align_mode": 1}, True)
+
+
+@pytest.mark.skipif(not _accelerator_reachable(),
+                    reason="no accelerator reachable (wedged tunnel or CPU-only)")
+def test_pallas_fused_local_compiled_on_chip():
+    """Compiled local-mode parity on the real accelerator (the full-width
+    band + SMEM best-state variant must lower on Mosaic)."""
+    code = _parity_child_code("seq.fa", {"align_mode": 1},
+                              force_int32=True, pin_cpu=False)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=900)
+    assert "PARITY-OK" in proc.stdout, proc.stderr[-2000:]
