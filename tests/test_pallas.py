@@ -69,8 +69,9 @@ out = io.StringIO()
 msa_from_file(Abpoa(), abpt, ns.input, out)
 sys.stdout.write(out.getvalue())
 """.format(root=root, path=os.path.join(DATA_DIR, "seq.fa"))
+    from test_pallas_fused import _device_env
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, timeout=900,
-                          env={**os.environ, "ABPOA_TPU_SKIP_PROBE": "1"})
+                          env={**_device_env(), "ABPOA_TPU_SKIP_PROBE": "1"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout == golden("ref_consensus.txt")
