@@ -291,3 +291,19 @@ def test_fused_local_mode(kw):
     if not kw:
         with open(os.path.join(GOLDEN_DIR, "seq_m1.txt")) as fp:
             assert got == fp.read()
+
+
+def test_fused_local_random_stress(tmp_path):
+    """Local mode on a random high-error read set (denser aligned-node
+    groups and more 0-clamped regions than the shipped data): fused device
+    loop vs the numpy oracle, byte parity."""
+    from test_property import _random_reads
+    rng = np.random.default_rng(29)
+    reads = _random_reads(rng, 8, 200, err=0.2)
+    fa = tmp_path / "loc.fa"
+    fa.write_text("".join(
+        f">r{i}\n" + "".join("ACGT"[b] for b in r) + "\n"
+        for i, r in enumerate(reads)))
+    got, _ = _consensus_via_fused(str(fa), align_mode=1)
+    want = _consensus_via_host(str(fa), align_mode=1)
+    assert got == want
