@@ -85,8 +85,9 @@ def record(step, lines, wall_s):
         for obj in lines:
             fp.write(json.dumps({"ts": now(), "step": step,
                                  "wall_s": round(wall_s, 1), **obj}) + "\n")
+    # NOT .chip_watcher_state.json: host-local resume state, gitignored
     subprocess.run(["git", "-C", HERE, "add", "BENCH_onchip.json",
-                    ".chip_watcher_state.json", "TPU_PROBE_LOG.jsonl"],
+                    "TPU_PROBE_LOG.jsonl"],
                    capture_output=True)
     subprocess.run(["git", "-C", HERE, "commit", "-m",
                     f"On-chip measurement: {step}",
