@@ -21,8 +21,8 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+import stat as stat_mod
 import sys
-import tempfile
 import time
 from typing import Optional
 
@@ -56,15 +56,17 @@ _PROBE_CODE = (
 _CACHE_TTL = float(os.environ.get("ABPOA_TPU_PROBE_CACHE_TTL", "300"))
 
 
-def _cache_path() -> str:
+def _cache_path() -> Optional[str]:
     # a user-private directory, NOT world-writable /tmp: a predictable /tmp
-    # path could be pre-created by another user with a planted verdict
+    # path could be pre-created by another user with a planted verdict or a
+    # symlink (ADVICE r4). If the private dir cannot be created the file
+    # cache is disabled outright — callers just re-probe.
     base = os.environ.get("XDG_RUNTIME_DIR") or os.path.expanduser("~/.cache")
     d = os.path.join(base, "abpoa_tpu")
     try:
         os.makedirs(d, mode=0o700, exist_ok=True)
     except Exception:
-        d = tempfile.gettempdir()
+        return None
     return os.path.join(d, "probe_verdict.json")
 
 
@@ -78,12 +80,25 @@ def _cache_fingerprint() -> str:
 def _cache_read():
     if _CACHE_TTL <= 0 or os.environ.get("ABPOA_TPU_TEST_WEDGE"):
         return None
+    path = _cache_path()
+    if path is None:
+        return None
     try:
-        path = _cache_path()
-        st = os.stat(path)
-        if hasattr(os, "getuid") and st.st_uid != os.getuid():
-            return None
-        with open(path) as fp:
+        # O_NOFOLLOW|O_NONBLOCK: a planted symlink or FIFO at the cache path
+        # must fail the open, not follow it or block forever (blocking here
+        # would be the exact hang this module exists to prevent). Then fstat
+        # the OPEN fd — a stat-then-open pair is a TOCTOU window where the
+        # file could be swapped between the uid check and the read (ADVICE
+        # r4) — and require a regular file owned by us.
+        fd = os.open(path, os.O_RDONLY
+                     | getattr(os, "O_NOFOLLOW", 0)
+                     | getattr(os, "O_NONBLOCK", 0))
+        with os.fdopen(fd) as fp:
+            st = os.fstat(fp.fileno())
+            if not stat_mod.S_ISREG(st.st_mode):
+                return None
+            if hasattr(os, "getuid") and st.st_uid != os.getuid():
+                return None
             d = json.load(fp)
         age = time.time() - d["ts"]
         if 0 <= age <= _CACHE_TTL and d.get("env") == _cache_fingerprint():
@@ -96,13 +111,35 @@ def _cache_read():
 def _cache_write(reachable: bool, platforms) -> None:
     if _CACHE_TTL <= 0 or os.environ.get("ABPOA_TPU_TEST_WEDGE"):
         return
+    path = _cache_path()
+    if path is None:
+        return
     try:
-        tmp = _cache_path() + ".tmp"
-        with open(tmp, "w") as fp:
-            json.dump({"ts": time.time(), "reachable": reachable,
-                       "platforms": sorted(platforms or []),
-                       "env": _cache_fingerprint()}, fp)
-        os.replace(tmp, _cache_path())
+        # per-pid tmp name: a writer SIGKILLed mid-write (the watcher kills
+        # whole process groups on step timeout) leaves a stale tmp behind;
+        # with a shared name the O_EXCL below would then fail every future
+        # write forever. O_NOFOLLOW|O_EXCL: refuse to traverse a pre-planted
+        # symlink at a predictable name (ADVICE r4).
+        tmp = f"{path}.{os.getpid()}.tmp"
+        # a recycled pid can inherit a predecessor's SIGKILL-orphaned tmp;
+        # clear it so O_EXCL means "no races NOW", not "no crashes EVER"
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL
+                     | getattr(os, "O_NOFOLLOW", 0), 0o600)
+        try:
+            with os.fdopen(fd, "w") as fp:
+                json.dump({"ts": time.time(), "reachable": reachable,
+                           "platforms": sorted(platforms or []),
+                           "env": _cache_fingerprint()}, fp)
+            os.replace(tmp, path)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     except Exception:
         pass
 
