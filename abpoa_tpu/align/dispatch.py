@@ -60,7 +60,9 @@ def _resolve(abpt: Params) -> Callable:
             # jax.devices() forever; probe out-of-process first so the CLI
             # degrades to the host kernel instead (the reference's dispatch
             # can never hang, src/abpoa_dispatch_simd.c:56-78)
-            from ..utils.probe import jax_backend_reachable, warn_unreachable_once
+            from ..utils.probe import (apply_platform_pin,
+                                       jax_backend_reachable,
+                                       warn_unreachable_once)
             if not jax_backend_reachable():
                 warn_unreachable_once(
                     "Warning: JAX backend probe timed out (wedged "
@@ -71,6 +73,7 @@ def _resolve(abpt: Params) -> Callable:
                 except Exception:
                     name = "numpy"
                 return _BACKENDS[name]
+            apply_platform_pin()
             from . import jax_backend  # lazy: registers "jax"
             if name == "pallas":
                 from . import pallas_backend  # registers "pallas"
@@ -108,8 +111,9 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
         # _resolve may have fallen back to a host kernel on a failed probe;
         # the batched-window path must honor that too or it would hang on
         # the same wedged backend init the probe just detected
-        from ..utils.probe import jax_backend_reachable
+        from ..utils.probe import apply_platform_pin, jax_backend_reachable
         if jax_backend_reachable():
+            apply_platform_pin()
             from .jax_backend import align_windows_jax
             return align_windows_jax(g, abpt, windows)
     return [fn(g, abpt, b, e, q) for b, e, q in windows]

@@ -1,0 +1,31 @@
+"""Fused-loop eligibility predicates.
+
+Kept in a module with NO jax dependency: callers consult these BEFORE the
+accelerator liveness probe (pipeline device rerouting, the `-l` lockstep
+runner), and importing any jax-touching module at that point could
+initialize a wedged backend and hang (utils/probe.py).
+"""
+from __future__ import annotations
+
+from .. import constants as C
+from ..params import Params
+
+
+def fused_config_eligible(abpt: Params) -> bool:
+    """Config-only part of fused-loop eligibility: the fused device loop
+    covers the reference's progressive-POA configurations in all three
+    align modes (global banded, extend with Z-drop, local unbanded);
+    remaining corners (-G path scores, qv-weighted multi-consensus) use
+    the host kernels (pipeline._reroute_device_ineligible)."""
+    return ((abpt.align_mode == C.LOCAL_MODE  # unbanded by definition
+             or (abpt.align_mode in (C.GLOBAL_MODE, C.EXTEND_MODE)
+                 and abpt.wb >= 0))
+            and not abpt.inc_path_score
+            and not (abpt.use_qv and abpt.max_n_cons > 1)
+            and abpt.ret_cigar)
+
+
+def fused_eligible(abpt: Params, n_seq: int) -> bool:
+    return (fused_config_eligible(abpt)
+            and not (abpt.incr_fn and abpt.use_read_ids)
+            and n_seq >= 2)

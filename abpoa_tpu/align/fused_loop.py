@@ -66,6 +66,9 @@ from jax import lax
 from .. import constants as C
 from ..params import Params
 from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
+# re-exported for device-path callers; defined in a jax-free module so
+# pre-probe callers never import this one
+from .eligibility import fused_config_eligible, fused_eligible  # noqa: F401
 from .jax_backend import _bucket, _bucket_pow2
 from .oracle import (INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit,
                      max_score_bound)
@@ -1403,20 +1406,6 @@ def _grow_state(state: FusedState, N2: int, E2: int, A2: int) -> FusedState:
         collisions=state.collisions, rc_flags=state.rc_flags)
 
 
-def fused_eligible(abpt: Params, n_seq: int) -> bool:
-    """The fused device loop covers the reference's progressive-POA
-    configurations in all three align modes (global banded, extend with
-    Z-drop, local unbanded); remaining corners (-G path scores, qv-weighted
-    multi-consensus, restored-graph read-id outputs) use the per-alignment
-    backends."""
-    return ((abpt.align_mode == C.LOCAL_MODE  # unbanded by definition
-             or (abpt.align_mode in (C.GLOBAL_MODE, C.EXTEND_MODE)
-                 and abpt.wb >= 0))
-            and not abpt.inc_path_score
-            and not (abpt.use_qv and abpt.max_n_cons > 1)
-            and not (abpt.incr_fn and abpt.use_read_ids)
-            and abpt.ret_cigar
-            and n_seq >= 2)
 
 
 def _state_from_host_graph(pg, N: int, E: int, A: int,
@@ -1478,6 +1467,89 @@ def _state_from_host_graph(pg, N: int, E: int, A: int,
         rc_flags=jnp.zeros(max(n_rc, 1), jnp.int32))
 
 
+# shared between the single-set and lockstep-batch drivers: bucket planning,
+# input padding, the 20-argument chunk call, and the growth policy live in
+# ONE place so the two paths cannot drift apart
+
+_RECOVERABLE_ERRS = (ERR_PROMOTE, ERR_NODE_CAP, ERR_OPS_CAP, ERR_BAND_CAP,
+                     ERR_EDGE_CAP, ERR_ALIGN_CAP, ERR_GRAPH_CAP)
+
+
+def _plan_buckets(abpt: Params, qmax: int) -> Tuple[int, int, bool]:
+    """(Qp, W, local_mode) for a workload whose longest read is qmax."""
+    Qp = _bucket(qmax + 2, 128)
+    local_m = abpt.align_mode == C.LOCAL_MODE
+    if local_m:
+        # local disables banding: every row spans the full query
+        W = max(128, _bucket_pow2(qmax + 2))
+    else:
+        w_full = abpt.wb + int(abpt.wf * qmax)
+        W = max(128, _bucket_pow2(2 * w_full + 4))
+    return Qp, W, local_m
+
+
+def _pad_read_set(seqs, weights, Qp: int, mat: np.ndarray, m: int):
+    """-> (seqs_pad, wgts_pad, lens, qp) host arrays for one read set."""
+    n = len(seqs)
+    seqs_pad = np.zeros((n, Qp), dtype=np.int32)
+    wgts_pad = np.ones((n, Qp), dtype=np.int32)
+    lens = np.zeros(n, dtype=np.int32)
+    qp = np.zeros((n, m, Qp), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        seqs_pad[i, : len(s)] = s
+        wgts_pad[i, : len(s)] = weights[i]
+        lens[i] = len(s)
+        qp[i, :, 1: len(s) + 1] = mat[:, s]
+    return seqs_pad, wgts_pad, lens, qp
+
+
+def _scalar_chunk_args(abpt: Params, inf_min: int):
+    """The per-chunk traced scalars, in run_fused_chunk positional order."""
+    return (jnp.int32(abpt.wb), jnp.float32(abpt.wf), jnp.int32(inf_min),
+            jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+            jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+            jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2))
+
+
+def _static_chunk_kwargs(abpt: Params, *, W: int, max_ops: int, plane16: bool,
+                         int16_limit: int, use_pallas: bool,
+                         pl_interpret: bool, record_paths: bool, amb: bool,
+                         local_m: bool) -> dict:
+    extend_m = abpt.align_mode == C.EXTEND_MODE
+    return dict(gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
+                gap_on_right=bool(abpt.put_gap_on_right),
+                put_gap_at_end=bool(abpt.put_gap_at_end),
+                plane16=plane16, max_mat=int(abpt.max_mat),
+                int16_limit=int(int16_limit),
+                use_pallas=bool(use_pallas), pl_interpret=pl_interpret,
+                record_paths=record_paths, amb_strand=amb,
+                extend=extend_m,
+                zdrop_on=extend_m and abpt.zdrop > 0,
+                zdrop=jnp.int32(max(abpt.zdrop, 0)), local=local_m)
+
+
+def _grown_caps(errs, N: int, E: int, A: int, W: int, plane16: bool):
+    """Collective growth policy: recoverable error codes -> new capacities.
+    Returns (N, E, A, W, plane16, grew) where `grew` means the device state
+    needs _grow_state (pure padding); W/plane16 changes need only an err
+    reset (the next chunk recompiles with the new statics)."""
+    grew = False
+    if any(e in (ERR_NODE_CAP, ERR_OPS_CAP, ERR_GRAPH_CAP) for e in errs):
+        N = _bucket(int(N * 1.7), 1024)
+        grew = True
+    if any(e in (ERR_EDGE_CAP, ERR_GRAPH_CAP) for e in errs):
+        E *= 2
+        grew = True
+    if any(e in (ERR_ALIGN_CAP, ERR_GRAPH_CAP) for e in errs):
+        A *= 2
+        grew = True
+    if ERR_BAND_CAP in errs:
+        W *= 2
+    if ERR_PROMOTE in errs:
+        plane16 = False
+    return N, E, A, W, plane16, grew
+
+
 def progressive_poa_fused(seqs: List[np.ndarray],
                           weights: List[np.ndarray],
                           abpt: Params,
@@ -1491,14 +1563,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     None starts from the empty graph."""
     n_reads = len(seqs)
     qmax = max(len(s) for s in seqs)
-    Qp = _bucket(qmax + 2, 128)
-    local_m = abpt.align_mode == C.LOCAL_MODE
-    if local_m:
-        # local disables banding: every row spans the full query
-        W = max(128, _bucket_pow2(qmax + 2))
-    else:
-        w_full = abpt.wb + int(abpt.wf * qmax)
-        W = max(128, _bucket_pow2(2 * w_full + 4))
+    Qp, W, local_m = _plan_buckets(abpt, qmax)
     n0 = 0
     E = 8
     A = 8
@@ -1515,18 +1580,9 @@ def progressive_poa_fused(seqs: List[np.ndarray],
         init_graph = None
     N = _bucket(n0 + 2 * (qmax + 2) + 64, 1024)
 
-    seqs_pad = np.zeros((n_reads, Qp), dtype=np.int32)
-    wgts_pad = np.ones((n_reads, Qp), dtype=np.int32)
-    lens = np.zeros(n_reads, dtype=np.int32)
-    for i, s in enumerate(seqs):
-        seqs_pad[i, : len(s)] = s
-        wgts_pad[i, : len(s)] = weights[i]
-        lens[i] = len(s)
     mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
-    # per-read query profiles, built once: (n_reads, m, Qp)
-    qp_all = np.zeros((n_reads, abpt.m, Qp), dtype=np.int32)
-    for i, s in enumerate(seqs):
-        qp_all[i, :, 1: len(s) + 1] = mat[:, s]
+    seqs_pad, wgts_pad, lens, qp_all = _pad_read_set(
+        seqs, weights, Qp, mat, abpt.m)
 
     seqs_d = jnp.asarray(seqs_pad)
     wgts_d = jnp.asarray(wgts_pad)
@@ -1544,8 +1600,6 @@ def progressive_poa_fused(seqs: List[np.ndarray],
 
     record_paths = bool(abpt.use_read_ids)
     amb = bool(abpt.amb_strand)
-    extend_m = abpt.align_mode == C.EXTEND_MODE
-    zdrop_on = extend_m and abpt.zdrop > 0
     if init_graph is not None and record_paths:
         # replayed bitsets cannot reconstruct the restored reads' edge sets
         raise RuntimeError(
@@ -1574,50 +1628,26 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                                       m=abpt.m, Qp=Qp)
         state = run_fused_chunk(
             state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
-            qp_d, mat_d, jnp.int32(abpt.wb), jnp.float32(abpt.wf),
-            jnp.int32(inf_min),
-            jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
-            jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
-            jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
-            gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
-            gap_on_right=bool(abpt.put_gap_on_right),
-            put_gap_at_end=bool(abpt.put_gap_at_end),
-            plane16=plane16, max_mat=int(abpt.max_mat),
-            int16_limit=int(int16_limit),
-            use_pallas=bool(up),
-            pl_interpret=pl_interpret, record_paths=record_paths,
-            amb_strand=amb, extend=extend_m, zdrop_on=zdrop_on,
-            zdrop=jnp.int32(max(abpt.zdrop, 0)), local=local_m)
+            qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
+            **_static_chunk_kwargs(
+                abpt, W=W, max_ops=max_ops, plane16=plane16,
+                int16_limit=int16_limit, use_pallas=up,
+                pl_interpret=pl_interpret, record_paths=record_paths,
+                amb=amb, local_m=local_m))
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:
             break
-        if err == ERR_PROMOTE:
-            plane16 = False
-            state = state._replace(err=jnp.int32(ERR_OK))
-        elif err in (ERR_NODE_CAP, ERR_OPS_CAP):
-            N = _bucket(int(N * 1.7), 1024)
-            state = _grow_state(state, N, E, A)
-        elif err == ERR_BAND_CAP:
-            W *= 2
-            state = state._replace(err=jnp.int32(ERR_OK))
-        elif err == ERR_EDGE_CAP:
-            E *= 2
-            state = _grow_state(state, N, E, A)
-        elif err == ERR_ALIGN_CAP:
-            A *= 2
-            state = _grow_state(state, N, E, A)
-        elif err == ERR_GRAPH_CAP:
-            # the sequential fallbacks report no dimension; grow them all
-            N = _bucket(int(N * 1.7), 1024)
-            E *= 2
-            A *= 2
-            state = _grow_state(state, N, E, A)
-        elif err == ERR_BACKTRACK:
+        if err == ERR_BACKTRACK:
             raise RuntimeError(
                 f"fused loop: device backtrack failed at read {done}")
-        else:
+        if err not in _RECOVERABLE_ERRS:
             raise RuntimeError(f"fused loop: unknown error {err} at read {done}")
+        N, E, A, W, plane16, grew = _grown_caps((err,), N, E, A, W, plane16)
+        if grew:
+            state = _grow_state(state, N, E, A)
+        else:
+            state = state._replace(err=jnp.int32(ERR_OK))
     else:
         raise RuntimeError("fused loop: capacity growth did not converge")
     kahn_total = int(state.kahn_runs)
@@ -1671,6 +1701,159 @@ def _replay_read_ids(pg, state: FusedState, n_reads: int) -> None:
         nd = pg.nodes[int(key) // n_nodes]
         slot = nd.out_ids.index(int(key) % n_nodes)
         nd.read_ids[slot] = int.from_bytes(words[e].tobytes(), "little")
+
+
+def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
+                                weight_sets: List[List[np.ndarray]],
+                                abpt: Params,
+                                max_chunks: int = 24,
+                                use_pallas: bool = None,
+                                mesh=None,
+                                _initial_caps: Optional[Tuple] = None):
+    """Lockstep multi-set batching: K independent read sets advance through
+    the fused progressive loop as ONE vmapped device dispatch per chunk.
+
+    The reference's `-l` file-list mode (src/abpoa.c:148-168) is
+    embarrassingly parallel across read sets; running K sets in lockstep on
+    a single chip amortizes the sequential per-step dispatch cost K-fold —
+    the one throughput lever that needs no cross-set communication. A set
+    that finishes early no-ops inside the vmapped while_loop (its `cond` is
+    already false); a set that trips a capacity code makes the WHOLE batch
+    grow (buckets are shared static shapes) and every unfinished set then
+    resumes exactly where it stopped, so results stay byte-identical to
+    sequential processing.
+
+    Returns a list of K entries, each `(host_graph, is_rc_flags)` or `None`
+    where that set must be re-run by the caller on a sequential path
+    (device backtrack divergence, or read-id replay unavailable after a
+    sequential-fusion collision).
+
+    mesh: an optional 1-axis `jax.sharding.Mesh`; the set axis is sharded
+    over its devices (GSPMD partitions the vmapped chunk, one set group per
+    device) — the multi-chip `-l` fleet path. Host-driven capacity growth
+    re-enters under the same sharding. K should be a multiple of the mesh
+    size. _initial_caps=(N, E, A, W) overrides the starting buckets
+    (tests/dryrun: force growth cheaply; undersized caps are recovered by
+    the normal grow-and-resume cycle).
+    """
+    K = len(seq_sets)
+    n_reads_v = np.array([len(s) for s in seq_sets], np.int32)
+    R = int(n_reads_v.max())
+    qmax = max(len(s) for ss in seq_sets for s in ss)
+    Qp, W, local_m = _plan_buckets(abpt, qmax)
+    E = 8
+    A = 8
+    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    if _initial_caps is not None:
+        N, E, A, W = _initial_caps
+
+    seqs_pad = np.zeros((K, R, Qp), dtype=np.int32)
+    wgts_pad = np.ones((K, R, Qp), dtype=np.int32)
+    lens = np.zeros((K, R), dtype=np.int32)
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+    qp_all = np.zeros((K, R, abpt.m, Qp), dtype=np.int32)
+    for k, ss in enumerate(seq_sets):
+        n = len(ss)
+        (seqs_pad[k, :n], wgts_pad[k, :n], lens[k, :n],
+         qp_all[k, :n]) = _pad_read_set(ss, weight_sets[k], Qp, mat, abpt.m)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def _shard(x):
+            # every per-set leaf has leading dim K: split it over the mesh
+            x = jnp.asarray(x)
+            spec = (PartitionSpec(mesh.axis_names[0]) if x.ndim >= 1
+                    else PartitionSpec())
+            return jax.device_put(x, NamedSharding(mesh, spec))
+    else:
+        def _shard(x):
+            return jnp.asarray(x)
+
+    seqs_d = _shard(seqs_pad)
+    wgts_d = _shard(wgts_pad)
+    lens_d = _shard(lens)
+    nreads_d = _shard(n_reads_v)
+    qp_d = _shard(qp_all)
+    mat_d = jnp.asarray(mat)
+
+    int16_limit = int16_score_limit(abpt)
+    plane16 = max_score_bound(abpt, qmax, 2) <= int16_limit
+    if use_pallas is None:
+        use_pallas = abpt.device == "pallas"
+    pl_interpret = jax.default_backend() != "tpu"
+    record_paths = bool(abpt.use_read_ids)
+    amb = bool(abpt.amb_strand)
+    if use_pallas:
+        from .pallas_fused import fits_vmem
+
+    def init_one():
+        return init_fused_state(N, E, A,
+                                n_reads=R if record_paths else 1,
+                                Pcap=Qp + 2 if record_paths else 8,
+                                n_rc=R if amb else 1)
+
+    state = jax.tree.map(lambda x: _shard(jnp.stack([x] * K)), init_one())
+    # sets frozen by an unrecoverable per-set error; their err stays
+    # non-OK so the vmapped while_loop skips them in later chunks
+    failed = np.zeros(K, dtype=bool)
+    for _ in range(max_chunks):
+        max_ops = N + Qp + 8
+        inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+        up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
+                                      m=abpt.m, Qp=Qp)
+
+        kwargs = _static_chunk_kwargs(
+            abpt, W=W, max_ops=max_ops, plane16=plane16,
+            int16_limit=int16_limit, use_pallas=up,
+            pl_interpret=pl_interpret, record_paths=record_paths,
+            amb=amb, local_m=local_m)
+
+        def chunk_one(st, sq, wg, ln, nr, qp):
+            return run_fused_chunk(
+                st, sq, wg, ln, nr, qp, mat_d,
+                *_scalar_chunk_args(abpt, inf_min), **kwargs)
+
+        state = jax.vmap(chunk_one)(state, seqs_d, wgts_d, lens_d,
+                                    nreads_d, qp_d)
+        errs = np.asarray(state.err)
+        done = np.asarray(state.read_idx)
+        failed |= ~np.isin(errs, (ERR_OK,) + _RECOVERABLE_ERRS)
+        if (failed | ((errs == ERR_OK) & (done >= n_reads_v))).all():
+            break
+        # collective growth: shared buckets mean one set's capacity need
+        # grows every set (pure padding — device state is preserved)
+        N, E, A, W, plane16, grew = _grown_caps(
+            set(errs[~failed].tolist()), N, E, A, W, plane16)
+        if grew:
+            state = jax.vmap(lambda s: _grow_state(s, N, E, A))(state)
+        # clear recoverable codes; re-freeze failed sets (_grow_state
+        # resets every err to OK)
+        new_err = np.where(failed, np.int32(ERR_BACKTRACK),
+                           np.where(np.isin(errs, _RECOVERABLE_ERRS),
+                                    np.int32(ERR_OK), errs))
+        state = state._replace(err=_shard(new_err.astype(np.int32)))
+    else:
+        raise RuntimeError(
+            "fused lockstep batch: capacity growth did not converge")
+
+    host = jax.device_get(state)
+    out = []
+    for k in range(K):
+        if failed[k]:
+            out.append(None)
+            continue
+        st_k = jax.tree.map(lambda x: x[k], host)
+        if record_paths and int(host.collisions[k]) > 0:
+            out.append(None)  # read-id replay unavailable for this set
+            continue
+        pg = _download_graph(st_k, abpt)
+        if record_paths:
+            _replay_read_ids(pg, st_k, int(n_reads_v[k]))
+        n_k = int(n_reads_v[k])
+        is_rc = ([bool(x) for x in np.asarray(st_k.rc_flags)[:n_k]]
+                 if amb else [False] * n_k)
+        out.append((pg, is_rc))
+    return out
 
 
 def _download_graph(state: FusedState, abpt: Params):

@@ -35,7 +35,6 @@ from .oracle import _build_index_map, INT32_MIN, dp_inf_min
 from .result import AlignResult
 from .dispatch import register_backend
 
-NEG_PAD = jnp.int32(INT32_MIN // 4)
 
 
 def _bucket(n: int, step: int) -> int:

@@ -135,14 +135,12 @@ def main(argv=None) -> int:
     try:
         if args.in_list:
             with open(args.input) as lf:
-                batch_index = 1
-                for line in lf:
-                    fn = line.strip()
-                    if not fn:
-                        continue
-                    abpt.batch_index = batch_index
-                    msa_from_file(ab, abpt, fn, out_fp)
-                    batch_index += 1
+                files = [ln.strip() for ln in lf if ln.strip()]
+            # run_batch lockstep-batches fused-eligible sets into one
+            # vmapped device dispatch per group (reference -l loop,
+            # src/abpoa.c:148-168, sequential there)
+            from .parallel import run_batch
+            run_batch(files, abpt, out_fp)
         else:
             msa_from_file(ab, abpt, args.input, out_fp)
     finally:

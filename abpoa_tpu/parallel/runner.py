@@ -3,40 +3,175 @@
 The POA algorithm needs no cross-chip collectives (SURVEY.md §2.3): the unit of
 work "align read set -> call consensus" fits one chip, so fleet scaling is data
 parallelism over read sets (the reference's `-l` file-list mode,
-/root/reference/src/abpoa.c:148-168). Two layers:
+/root/reference/src/abpoa.c:148-168). Three layers:
 
-- `run_batch`: round-robin read-set files over local devices; each set's DP
-  kernels are placed on its device via `jax.default_device`, host fusion stays
-  on CPU threads. No collectives ride the interconnect.
+- lockstep batching (`_lockstep_compute`): K read sets advance through the
+  fused progressive loop as ONE vmapped dispatch per device
+  (fused_loop.progressive_poa_fused_batch) — the per-chip throughput lever:
+  each sequential graph-row step now carries K sets' worth of work.
+- `run_batch`: the `-l` product path. Uses lockstep groups when the config is
+  in fused-loop scope, else round-robins files over local devices with each
+  set's DP kernels placed via `jax.default_device`.
 - `shard_dp_batch`: a `shard_map`-over-Mesh batched DP step — many same-bucket
-  alignments at once, one per mesh slot. This is the building block for the
-  all-device progressive loop (PERF.md) and for multi-host DCN fan-out, where
-  each host feeds its local mesh slice.
+  alignments at once, one per mesh slot. Building block for multi-host DCN
+  fan-out, where each host feeds its local mesh slice.
 """
 from __future__ import annotations
 
+import os
+import sys
 from typing import IO, List, Sequence
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
-
 from ..params import Params
+
+# jax is imported lazily inside each entry point: a host-only `-l` run
+# (device numpy/native) must not pay the jax import, and the CLI routes
+# every file list through run_batch
+
+
+def lockstep_group_size() -> int:
+    """Sets per lockstep dispatch. Shared static buckets mean K sets cost
+    K x the largest set's plane memory; 8 fits comfortably in 16 GB HBM for
+    the north-star workload (500 reads x 10 kb: ~45 MB of planes + graph
+    arrays per set at W=4096). Override via ABPOA_TPU_LOCKSTEP_K; 1
+    disables grouping (sets still run the fused loop, one per dispatch)."""
+    return max(1, int(os.environ.get("ABPOA_TPU_LOCKSTEP_K", "8")))
+
+
+def _lockstep_ok(abpt: Params) -> bool:
+    from ..pipeline import plain_route
+    from ..align.eligibility import fused_config_eligible
+    return (abpt.device in ("jax", "tpu", "pallas")
+            and not abpt.incr_fn
+            and plain_route(abpt)
+            and fused_config_eligible(abpt))
+
+
+def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
+    """Run one lockstep group; returns {file_idx: Abpoa-with-finished-graph}.
+    Entries absent from the result (whole-batch failure, or a per-set device
+    failure) take the sequential path."""
+    if not group:
+        return {}
+    import jax
+    from ..align.fused_loop import progressive_poa_fused_batch
+    results: dict = {}
+    dev = devices[gi % len(devices)]
+    try:
+        with jax.default_device(dev):
+            outs = progressive_poa_fused_batch(
+                [e[2] for e in group], [e[3] for e in group], abpt)
+    except RuntimeError as e:
+        print(f"Warning: fused lockstep batch failed ({e}); "
+              "falling back to sequential processing.", file=sys.stderr)
+        return {}
+    for (idx, ab, _seqs, _w), res in zip(group, outs):
+        if res is None:
+            continue
+        pg, is_rc = res
+        ab.graph = pg
+        if abpt.amb_strand:
+            for j, flag in enumerate(is_rc):
+                ab.is_rc[j] = flag
+        # reads are fused; output walks only names/quals/graph. Blank the
+        # sequence strings so the segment doesn't hold every set's reads
+        # in memory at once (n_seq must stay correct).
+        ab.seqs = [""] * len(ab.seqs)
+        results[idx] = ab
+    return results
 
 
 def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
               devices: List = None) -> None:
-    """Process independent read-set files, round-robin across devices."""
-    from ..pipeline import Abpoa, msa_from_file
-    devices = devices or jax.devices()
-    ab = Abpoa()
-    for i, fn in enumerate(files):
+    """Process independent read-set files (the `-l` mode): lockstep-batched
+    on device when eligible, sequential round-robin otherwise. Output order
+    and bytes match sequential processing exactly.
+
+    Lockstep processing streams SEGMENT by segment (a segment ends when K
+    eligible sets have accumulated): each segment is computed as one
+    vmapped dispatch, then emitted in file order, so peak memory is one
+    group's read sets + graphs — not the whole file list."""
+    from ..pipeline import Abpoa, msa_from_file, output
+    if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
+        return  # mirror msa_from_file: nothing to emit, nothing to compute
+    lock = _lockstep_ok(abpt)
+    if devices is None:
+        if lock or abpt.device in ("jax", "tpu", "pallas"):
+            # probe BEFORE jax.devices(): a wedged accelerator tunnel hangs
+            # any in-process backend init forever (utils/probe.py); the
+            # per-file msa path then falls back to the host engine itself
+            from ..utils.probe import (apply_platform_pin,
+                                       jax_backend_reachable,
+                                       warn_unreachable_once)
+            if jax_backend_reachable():
+                apply_platform_pin()
+                import jax
+                devices = jax.devices()
+            else:
+                warn_unreachable_once(
+                    "Warning: JAX backend probe timed out (wedged "
+                    "accelerator tunnel?); falling back to the host engine.")
+                lock = False
+                devices = [None]
+        else:
+            devices = [None]
+
+    def run_one(ab, i, fn):
         abpt.batch_index = i + 1
         dev = devices[i % len(devices)]
-        with jax.default_device(dev):
+        if dev is None:
             msa_from_file(ab, abpt, fn, out_fp)
+        else:
+            import jax
+            with jax.default_device(dev):
+                msa_from_file(ab, abpt, fn, out_fp)
+
+    if not lock:
+        ab = Abpoa()
+        for i, fn in enumerate(files):
+            run_one(ab, i, fn)
+        return
+
+    from ..align.eligibility import fused_eligible
+    from ..io.fastx import read_fastx
+    from ..pipeline import _ingest_records
+    K = lockstep_group_size()
+    ab_seq = Abpoa()
+    seg: List = []    # [(file_idx, fn)] for the current segment
+    group: List = []  # [(file_idx, ab, seqs, weights)] eligible subset
+    gi = 0
+
+    def emit_segment() -> None:
+        nonlocal gi
+        results = _flush_group(group, abpt, devices, gi)
+        gi += 1
+        for idx, fn in seg:
+            if idx in results:
+                abpt.batch_index = idx + 1
+                output(results[idx], abpt, out_fp)
+            else:
+                # ineligible or device-failed: sequential path (re-reads the
+                # file; IO is negligible next to alignment)
+                run_one(ab_seq, idx, fn)
+        seg.clear()
+        group.clear()
+
+    for i, fn in enumerate(files):
+        try:
+            records = read_fastx(fn)
+            ab = Abpoa()
+            seqs, weights = _ingest_records(ab, abpt, records)
+        except Exception:
+            emit_segment()  # files before the bad one still emit, in order
+            raise
+        seg.append((i, fn))
+        if fused_eligible(abpt, len(seqs)):
+            group.append((i, ab, seqs, weights))
+        if len(group) == K:
+            emit_segment()
+    emit_segment()
 
 
 def shard_dp_batch(mesh_devices: int = None):
@@ -47,6 +182,9 @@ def shard_dp_batch(mesh_devices: int = None):
     mesh slot. Used by __graft_entry__.dryrun_multichip and as the scaffold for
     multi-set batch processing.
     """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
     from ..align.jax_backend import _dp_scan
     from .. import constants as C
 

@@ -42,6 +42,15 @@ class Abpoa:
         self.seqs, self.is_rc = [], []
         self.cons = None
 
+    def append_read(self, name: str = "", comment: str = "",
+                    qual: Optional[str] = None, seq: str = "",
+                    is_rc: bool = False) -> None:
+        self.names.append(name)
+        self.comments.append(comment)
+        self.quals.append(qual)
+        self.seqs.append(seq)
+        self.is_rc.append(is_rc)
+
 
 def _rc_encode(seq: np.ndarray) -> np.ndarray:
     rc = seq[::-1].copy()
@@ -82,15 +91,18 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     (align/fused_loop.py). Returns False to fall back to the per-read loop."""
     if abpt.device not in ("jax", "tpu", "pallas"):
         return False
-    from .utils.probe import jax_backend_reachable, warn_unreachable_once
+    from .utils.probe import (apply_platform_pin, jax_backend_reachable,
+                              warn_unreachable_once)
     if not jax_backend_reachable():
         warn_unreachable_once(
             "Warning: JAX backend probe timed out (wedged accelerator "
             "tunnel?); falling back to the host engine.")
         return False
-    from .align.fused_loop import fused_eligible, progressive_poa_fused
+    apply_platform_pin()
+    from .align.eligibility import fused_eligible
     if not fused_eligible(abpt, len(seqs)):
         return False
+    from .align.fused_loop import progressive_poa_fused
     init_graph = None
     if exist_n_seq:
         # incremental `-i`: extend the restored graph on device; read-id
@@ -129,28 +141,13 @@ def _want_native(abpt: Params) -> bool:
             and not abpt.inc_path_score and abpt.zdrop <= 0)
 
 
-def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
-    """File-level driver (reference abpoa_msa1)."""
-    assert abpt._finalized, "call Params.finalize() first"
-    if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
-        try:
-            from .native.graph import NativePOAGraph
-            ab.graph = NativePOAGraph()
-        except Exception:
-            pass
-    elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
-        ab.graph = POAGraph()
-    ab.reset()
-    if abpt.incr_fn:
-        from .io.restore import restore_graph
-        restore_graph(ab, abpt)
+def _ingest_records(ab: Abpoa, abpt: Params, records):
+    """Append records to `ab` (sorting per `-s`), encode sequences, derive
+    qv weights (reference abpoa_msa1 read/encode block,
+    src/abpoa_align.c:493-506). Returns (seqs, weights) for the new reads."""
     exist_n_seq = ab.n_seq
     for rec in records:
-        ab.names.append(rec.name)
-        ab.comments.append(rec.comment)
-        ab.quals.append(rec.qual)
-        ab.seqs.append(rec.seq)
-        ab.is_rc.append(False)
+        ab.append_read(rec.name, rec.comment, rec.qual, rec.seq)
     n_seq = len(records)
     if abpt.sort_input_seq:
         order = sorted(range(n_seq), key=lambda i: -len(records[i].seq))
@@ -170,8 +167,90 @@ def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
             weights.append(np.frombuffer(qual.encode(), dtype=np.uint8).astype(np.int64) - 32)
         else:
             weights.append(np.ones(len(arr), dtype=np.int64))
+    return seqs, weights
 
-    if (abpt.disable_seeding and not abpt.progressive_poa) or abpt.align_mode != C.GLOBAL_MODE:
+
+def plain_route(abpt: Params) -> bool:
+    """True when the progressive loop runs in input order (no seeding/guide
+    tree) — the route the fused device loop covers."""
+    return ((abpt.disable_seeding and not abpt.progressive_poa)
+            or abpt.align_mode != C.GLOBAL_MODE)
+
+
+def _device_ineligible_reason(abpt: Params) -> Optional[str]:
+    """A device config the fused loop excludes would otherwise fall to
+    per-alignment device dispatches — the link-latency regime (~140 ms per
+    read over a remote tunnel). Those configs run the native host kernel
+    instead (reference behavior: one engine end to end)."""
+    if abpt.device not in ("jax", "tpu", "pallas") or not plain_route(abpt):
+        return None
+    if abpt.incr_fn and abpt.use_read_ids:
+        # fused loop can't replay restored reads' edge bitsets; without a
+        # reroute this would fall to per-read device dispatches
+        return "incremental MSA with read-id outputs"
+    from .align.eligibility import fused_config_eligible
+    if fused_config_eligible(abpt):
+        return None
+    if abpt.inc_path_score:
+        return "-G/path-score mode"
+    if abpt.use_qv and abpt.max_n_cons > 1:
+        return "qv-weighted multi-consensus"
+    if not abpt.ret_cigar:
+        return "cigar-free alignment"
+    return "unbanded device config"
+
+
+_REROUTE_WARNED = False
+
+
+def _reroute_device_ineligible(abpt: Params) -> Optional[str]:
+    """Returns the original device name when rerouted, else None."""
+    global _REROUTE_WARNED
+    reason = _device_ineligible_reason(abpt)
+    if reason is None:
+        return None
+    try:
+        from .native import load
+        host = "native" if load() is not None else "numpy"
+    except Exception:
+        host = "numpy"
+    if not _REROUTE_WARNED:
+        print(f"Warning: {reason} is outside the fused device loop; "
+              f"using the {host} host kernel for this configuration.",
+              file=sys.stderr)
+        _REROUTE_WARNED = True
+    orig, abpt.device = abpt.device, host
+    return orig
+
+
+def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
+    """File-level driver (reference abpoa_msa1)."""
+    assert abpt._finalized, "call Params.finalize() first"
+    orig_device = _reroute_device_ineligible(abpt)
+    try:
+        _msa_inner(ab, abpt, records, out_fp)
+    finally:
+        if orig_device is not None:
+            abpt.device = orig_device
+
+
+def _msa_inner(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
+    if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
+        try:
+            from .native.graph import NativePOAGraph
+            ab.graph = NativePOAGraph()
+        except Exception:
+            pass
+    elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
+        ab.graph = POAGraph()
+    ab.reset()
+    if abpt.incr_fn:
+        from .io.restore import restore_graph
+        restore_graph(ab, abpt)
+    exist_n_seq = ab.n_seq
+    seqs, weights = _ingest_records(ab, abpt, records)
+
+    if plain_route(abpt):
         if not _run_fused_device(ab, abpt, seqs, weights, exist_n_seq):
             poa(ab, abpt, seqs, weights, exist_n_seq)
     else:

@@ -103,15 +103,13 @@ class msa_aligner:
             res = align_sequence_to_graph(g, abpt, bseq)
             g.add_alignment(abpt, bseq, weights, None, res.cigar,
                             exist_n + read_i, tot_n, True)
-            self.ab.names.append("")
-            self.ab.comments.append("")
-            self.ab.quals.append(None)
-            self.ab.seqs.append(seq)
-            self.ab.is_rc.append(False)
+            self.ab.append_read(seq=seq)
 
-    def _collect(self, n_seq: int) -> msa_result:
+    def _collect(self, n_seq: int, ab: Abpoa = None) -> msa_result:
         abpt = self.abpt
-        g = self.ab.graph
+        if ab is None:
+            ab = self.ab
+        g = ab.graph
         if getattr(g, "is_native", False):
             g = g.to_python(abpt)
         if abpt.out_msa:
@@ -128,7 +126,7 @@ class msa_aligner:
         if abc.msa_len > 0:
             for row in abc.msa_base:
                 msa_seq.append("".join(chr(decode[b]) for b in row))
-        self.ab.cons = abc
+        ab.cons = abc
         return msa_result(n_seq, abc.n_cons, list(abc.clu_n_seq),
                           [list(x) for x in abc.clu_read_ids], abc.cons_len,
                           cons_seq, [list(c) for c in abc.cons_cov], cons_qv,
@@ -170,6 +168,93 @@ class msa_aligner:
             from .io.plot import dump_pog
             dump_pog(self.ab, abpt)
         return result
+
+    def msa_batch(self, seq_sets, out_cons, out_msa, max_n_cons=1,
+                  min_freq=0.25, qscores_sets=None) -> List[msa_result]:
+        """Lockstep multi-set batching: K independent read sets advance
+        through the fused progressive loop as one vmapped device dispatch
+        per chunk (the CLI's `-l` file-list mode; the reference processes
+        sets sequentially, src/abpoa.c:148-168). Sets outside fused-loop
+        scope — or when no device backend is selected — fall back to the
+        sequential `msa()` path; results are identical either way."""
+        if qscores_sets is not None and len(qscores_sets) != len(seq_sets):
+            raise ValueError("qscores_sets must contain one entry per set.")
+        abpt = self.abpt
+        abpt.out_cons = bool(out_cons)
+        abpt.out_msa = bool(out_msa)
+        if not 1 <= max_n_cons <= 2:
+            raise Exception(
+                "Error: max number of consensus sequences should be 1 or 2.")
+        abpt.max_n_cons = max_n_cons
+        abpt.min_freq = min_freq
+        abpt.use_qv = qscores_sets is not None
+        abpt.incr_fn = None
+        abpt.finalize()
+        from .align.eligibility import fused_eligible
+
+        def seq_fallback(k):
+            qs = qscores_sets[k] if qscores_sets is not None else None
+            return self.msa(seq_sets[k], out_cons, out_msa, max_n_cons,
+                            min_freq, qscores=qs)
+
+        results: List[msa_result] = [None] * len(seq_sets)
+        lockstep: List[int] = []
+        enc_sets, wgt_sets = [], []
+        eligible = abpt.device in ("jax", "tpu", "pallas")
+        if eligible:
+            from .pipeline import plain_route
+            from .utils.probe import jax_backend_reachable
+            eligible = plain_route(abpt) and jax_backend_reachable()
+            if eligible:
+                from .utils.probe import apply_platform_pin
+                apply_platform_pin()
+        enc = abpt.char_to_code
+        for k, seqs in enumerate(seq_sets):
+            if not (eligible and fused_eligible(abpt, len(seqs))):
+                continue
+            if (qscores_sets is not None
+                    and len(qscores_sets[k]) != len(seqs)):
+                raise ValueError(
+                    "qscores must contain one entry per input sequence.")
+            bseqs, wgts = [], []
+            for i, seq in enumerate(seqs):
+                b = enc[np.frombuffer(seq.encode(),
+                                      dtype=np.uint8)].astype(np.uint8)
+                bseqs.append(b)
+                if qscores_sets is not None:
+                    q = np.asarray(qscores_sets[k][i], dtype=np.int64)
+                    if len(q) != len(seq):
+                        raise ValueError(
+                            "Each qscore array must have the same length "
+                            "as its sequence.")
+                    if (q < 0).any():
+                        raise ValueError(
+                            "Qscores must be non-negative integers.")
+                    wgts.append(q)
+                else:
+                    wgts.append(np.ones(len(b), dtype=np.int64))
+            lockstep.append(k)
+            enc_sets.append(bseqs)
+            wgt_sets.append(wgts)
+        if lockstep:
+            from .align.fused_loop import progressive_poa_fused_batch
+            try:
+                outs = progressive_poa_fused_batch(enc_sets, wgt_sets, abpt)
+            except RuntimeError:
+                outs = [None] * len(lockstep)
+            for k, res in zip(lockstep, outs):
+                if res is None:
+                    continue
+                pg, _is_rc = res
+                ab = Abpoa()
+                for seq in seq_sets[k]:
+                    ab.append_read(seq=seq)
+                ab.graph = pg
+                results[k] = self._collect(len(seq_sets[k]), ab=ab)
+        for k in range(len(seq_sets)):
+            if results[k] is None:
+                results[k] = seq_fallback(k)
+        return results
 
     def msa_align(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,
                   incr_fn="", qscores=None) -> "msa_aligner":

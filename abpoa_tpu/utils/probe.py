@@ -239,6 +239,22 @@ def warn_unreachable_once(msg: str) -> None:
         _WARNED = True
 
 
+def apply_platform_pin() -> None:
+    """Mirror the probe child's platform pin in-process. The probe child
+    applies JAX_PLATFORMS via `jax.config.update` because the env var alone
+    loses the race against a site hook's device plugin (round-2 finding).
+    A caller that trusts the probe verdict and then initializes jax
+    in-process must apply the SAME pin, or its init can land on the wedged
+    platform the probe child never touched. No-op without the env var."""
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        try:
+            import jax
+            jax.config.update("jax_platforms", p)
+        except Exception:
+            pass
+
+
 def reset_probe_cache() -> None:
     global _PROBE_RESULT, _WARNED, _PLATFORMS
     _PROBE_RESULT = None

@@ -94,6 +94,26 @@ def _time_run_subprocess(device, path, warm, timeout):
     raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
 
 
+def _time_run_cpu_fused(path, timeout=900):
+    """Time the fused device loop on the CPU jax backend (VERDICT r4 #7):
+    the device-path code gets a committed bench row every round, even when
+    no accelerator answers. Subprocess: the config-level CPU pin must land
+    before any backend init, and the probe child reads JAX_PLATFORMS."""
+    code = (
+        "import os, sys; sys.path.insert(0, {here!r})\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "print('WALL', bench._time_run('jax', {path!r}, warm=True))\n"
+    ).format(here=HERE, path=path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, env=env)
+    for line in proc.stdout.splitlines():
+        if line.startswith("WALL "):
+            return float(line.split()[1])
+    raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
+
+
 def _run_workload(key, path, n_reads, devices, warm, per_backend, results):
     for device in devices:
         try:
@@ -136,6 +156,15 @@ def main():
     sim2k = workloads["sim2k"]
     _run_workload("sim2k", os.path.join(HERE, sim2k["file"]),
                   sim2k["n_reads"], devices, True, per_backend, results)
+
+    # fused-loop CPU row: tracks the device-path code on every platform
+    # (reported in extra only — it never competes for the headline device)
+    try:
+        wall = _time_run_cpu_fused(os.path.join(HERE, sim2k["file"]))
+        per_backend.setdefault("sim2k", {})["fused_cpu"] = round(
+            sim2k["n_reads"] / wall, 2)
+    except Exception as e:
+        print(f"[bench] fused_cpu sim2k failed: {e}", file=sys.stderr)
 
     sim10k = workloads["sim10k_500"]
     p10k = _ensure_sim10k(

@@ -153,6 +153,17 @@ STEPS = [
     ("pallas_k1_i32", [PY, MICRO, "--task", "pallas", "--unroll-k", "1"], 900),
     ("pallas_k8_i16", [PY, MICRO, "--task", "pallas", "--unroll-k", "8",
                        "--plane16"], 900),
+    # lockstep multi-set batching: the per-chip throughput lever (reads/s
+    # should scale ~K for any per-step cost); K=1 is the baseline
+    ("lockstep_k1_10x10k", [PY, MICRO, "--task", "lockstep", "--device",
+                            "jax", "--lockstep-k", "1", "--n-reads", "10"],
+     1800),
+    ("lockstep_k4_10x10k", [PY, MICRO, "--task", "lockstep", "--device",
+                            "jax", "--lockstep-k", "4", "--n-reads", "10"],
+     2400),
+    ("lockstep_k8_10x10k", [PY, MICRO, "--task", "lockstep", "--device",
+                            "jax", "--lockstep-k", "8", "--n-reads", "10"],
+     3000),
     ("e2e_jax_10x10k", [PY, MICRO, "--task", "e2e", "--device", "jax",
                         "--n-reads", "10"], 1200),
     ("e2e_pallas_10x10k", [PY, MICRO, "--task", "e2e", "--device", "pallas",

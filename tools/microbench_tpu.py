@@ -162,10 +162,58 @@ def task_e2e(device: str, n_reads: int, ref_len: int) -> None:
          reads_per_sec=round(n_reads / warm, 3))
 
 
+def _ensure_sim_seeded(n_reads: int, ref_len: int, seed: int) -> str:
+    import getpass
+    path = (f"/tmp/mb_sim{ref_len}_{n_reads}_s{seed}."
+            f"{getpass.getuser()}.fa")
+    try:
+        with open(path) as fp:
+            if sum(1 for l in fp if l.startswith(">")) == n_reads:
+                return path
+    except OSError:
+        pass
+    subprocess.run(
+        [sys.executable, os.path.join(HERE, "tests", "make_sim.py"),
+         "--ref-len", str(ref_len), "--n-reads", str(n_reads), "--err", "0.1",
+         "--seed", str(seed), "--out", path], check=True)
+    return path
+
+
+def task_lockstep(device: str, k: int, n_reads: int, ref_len: int) -> None:
+    """Reads/s for K read sets run as ONE lockstep vmapped fused-loop batch
+    on a single chip (parallel/runner lockstep path) vs K=1. The per-chip
+    throughput lever: each sequential graph-row step carries K sets."""
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused_batch
+    abpt = Params()
+    abpt.device = device
+    abpt.finalize()
+    sets, wsets = [], []
+    for s in range(k):
+        p = _ensure_sim_seeded(n_reads, ref_len, 20 + s)
+        ab = Abpoa()
+        seqs, weights = _ingest_records(ab, abpt, read_fastx(p))
+        sets.append(seqs)
+        wsets.append(weights)
+    t0 = time.perf_counter()
+    outs = progressive_poa_fused_batch(sets, wsets, abpt)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = progressive_poa_fused_batch(sets, wsets, abpt)
+    warm = time.perf_counter() - t0
+    ok = sum(o is not None for o in outs)
+    emit(task="lockstep", platform=_platform(), device=device, k=k,
+         n_reads=n_reads, ref_len=ref_len, sets_ok=ok,
+         cold_wall_s=round(cold, 3), warm_wall_s=round(warm, 3),
+         reads_per_sec=round(k * n_reads / warm, 3))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--task", required=True,
-                    choices=["floor", "pallas", "e2e"])
+                    choices=["floor", "pallas", "e2e", "lockstep"])
     ap.add_argument("--iters", type=int, default=100_000)
     ap.add_argument("--rows", type=int, default=8192)
     ap.add_argument("--band", type=int, default=384)
@@ -176,6 +224,8 @@ def main():
                     help="CPU shape/semantics validation only")
     ap.add_argument("--n-reads", type=int, default=10)
     ap.add_argument("--ref-len", type=int, default=10000)
+    ap.add_argument("--lockstep-k", type=int, default=8,
+                    help="sets per lockstep batch (task=lockstep)")
     a = ap.parse_args()
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(HERE, ".jax_cache"))
@@ -188,6 +238,8 @@ def main():
         task_floor(a.iters)
     elif a.task == "pallas":
         task_pallas(a.rows, a.band, a.unroll_k, a.plane16, a.interpret)
+    elif a.task == "lockstep":
+        task_lockstep(a.device, a.lockstep_k, a.n_reads, a.ref_len)
     else:
         task_e2e(a.device, a.n_reads, a.ref_len)
 
