@@ -1051,7 +1051,7 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
 @functools.partial(jax.jit, static_argnames=(
     "gap_mode", "W", "max_ops", "gap_on_right", "put_gap_at_end", "plane16",
     "max_mat", "int16_limit", "use_pallas", "pl_interpret", "record_paths",
-    "amb_strand", "extend", "zdrop_on", "local"))
+    "amb_strand", "extend", "zdrop_on", "local", "pallas_hbm"))
 def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     qp_mat, mat, w_scalar_b, w_scalar_f, inf_min,
                     o1, e1, oe1, o2, e2, oe2,
@@ -1063,7 +1063,8 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     record_paths: bool = False,
                     amb_strand: bool = False,
                     extend: bool = False, zdrop_on: bool = False,
-                    zdrop=0, local: bool = False) -> FusedState:
+                    zdrop=0, local: bool = False,
+                    pallas_hbm: bool = False) -> FusedState:
     """The single-dispatch progressive loop: while reads remain and no
     capacity/error exit, align + fuse the next read entirely on device."""
     N, E = state.g.in_ids.shape
@@ -1122,7 +1123,7 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                         plane16=plane16, extend=extend, zdrop_on=zdrop_on,
                         zdrop=zdrop, local=local)
 
-                if use_pallas:
+                if use_pallas or pallas_hbm:
                     # Pallas banded kernel (VMEM ring, pallas_fused.py); falls
                     # back in-jit to the XLA scan on ring/band overflow
                     # (measured rate on sim10k graphs: 0.0%, PERF.md). Covers
@@ -1147,13 +1148,26 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     sc = jnp.stack([qlen, w, remain_end, inf_min, e1, oe1,
                                     e2, oe2, n, dp_end0, jnp.int32(zdrop)]
                                    + [jnp.int32(0)] * 5)
-                    (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p,
-                     ext_p) = pallas_fused_dp(
-                        sc, base_packed, pre_idx, pre_cnt, out_idx, out_cnt_r,
-                        remain_rows, row0H, row0E1, row0E2, qp_padW,
-                        R=N_, W=W, P=E_, O=E_, gap_mode=gap_mode,
-                        plane16=plane16, extend=extend, zdrop_on=zdrop_on,
-                        local=local, interpret=pl_interpret)
+                    if pallas_hbm:
+                        # local at VMEM-breaking widths: HBM-resident plane
+                        # history, no rings, no overflow conditions
+                        from .pallas_fused import pallas_fused_dp_local_hbm
+                        (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p,
+                         ext_p) = pallas_fused_dp_local_hbm(
+                            sc, base_packed, pre_idx, pre_cnt, out_idx,
+                            out_cnt_r, remain_rows, row0H, row0E1, row0E2,
+                            qp_padW, R=N_, W=W, P=E_, O=E_,
+                            gap_mode=gap_mode, plane16=plane16,
+                            interpret=pl_interpret)
+                    else:
+                        (Hp, E1p, E2p, F1p, F2p, beg_p, end_p, ok_p,
+                         ext_p) = pallas_fused_dp(
+                            sc, base_packed, pre_idx, pre_cnt, out_idx,
+                            out_cnt_r, remain_rows, row0H, row0E1, row0E2,
+                            qp_padW,
+                            R=N_, W=W, P=E_, O=E_, gap_mode=gap_mode,
+                            plane16=plane16, extend=extend, zdrop_on=zdrop_on,
+                            local=local, interpret=pl_interpret)
                     # the kernel writes rows 1..: patch the source row in
                     end_p = end_p.at[0].set(dp_end0)
                     beg_p = beg_p.at[0].set(0)
@@ -1166,9 +1180,13 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                                 zeros, zeros, jnp.bool_(False),
                                 ext_p[0], ext_p[1], ext_p[2])
 
-                    (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                     overflow, ext_sc, ext_i, ext_j) = lax.cond(
-                         ok_p[0] == 1, take_pl, dp_scan_path, None)
+                    if pallas_hbm:  # ok is always 1: no fallback branch
+                        (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                         overflow, ext_sc, ext_i, ext_j) = take_pl(None)
+                    else:
+                        (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
+                         overflow, ext_sc, ext_i, ext_j) = lax.cond(
+                             ok_p[0] == 1, take_pl, dp_scan_path, None)
                 else:
                     (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
                      overflow, ext_sc, ext_i, ext_j) = dp_scan_path(None)
@@ -1514,7 +1532,7 @@ def _scalar_chunk_args(abpt: Params, inf_min: int):
 def _static_chunk_kwargs(abpt: Params, *, W: int, max_ops: int, plane16: bool,
                          int16_limit: int, use_pallas: bool,
                          pl_interpret: bool, record_paths: bool, amb: bool,
-                         local_m: bool) -> dict:
+                         local_m: bool, pallas_hbm: bool = False) -> dict:
     extend_m = abpt.align_mode == C.EXTEND_MODE
     return dict(gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
                 gap_on_right=bool(abpt.put_gap_on_right),
@@ -1525,7 +1543,8 @@ def _static_chunk_kwargs(abpt: Params, *, W: int, max_ops: int, plane16: bool,
                 record_paths=record_paths, amb_strand=amb,
                 extend=extend_m,
                 zdrop_on=extend_m and abpt.zdrop > 0,
-                zdrop=jnp.int32(max(abpt.zdrop, 0)), local=local_m)
+                zdrop=jnp.int32(max(abpt.zdrop, 0)), local=local_m,
+                pallas_hbm=bool(pallas_hbm))
 
 
 def _grown_caps(errs, N: int, E: int, A: int, W: int, plane16: bool):
@@ -1617,15 +1636,19 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                                  Pcap=Qp + 2 if record_paths else 8,
                                  n_rc=n_reads if amb else 1)
     if use_pallas:
-        from .pallas_fused import fits_vmem
+        from .pallas_fused import fits_vmem, fits_vmem_local_hbm
     kahn_total = 0
     for _ in range(max_chunks):
         max_ops = N + Qp + 8
         inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
         # static VMEM guard: local mode (and band growth) can push W past
-        # what the kernel's rings fit; those compiles take the XLA scan
+        # what the kernel's rings fit; local falls to the HBM-resident
+        # variant, everything else to the XLA scan
         up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
                                       m=abpt.m, Qp=Qp)
+        up_hbm = (use_pallas and not up and local_m
+                  and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
+                                          m=abpt.m, Qp=Qp))
         state = run_fused_chunk(
             state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
             qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
@@ -1633,7 +1656,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                 abpt, W=W, max_ops=max_ops, plane16=plane16,
                 int16_limit=int16_limit, use_pallas=up,
                 pl_interpret=pl_interpret, record_paths=record_paths,
-                amb=amb, local_m=local_m))
+                amb=amb, local_m=local_m, pallas_hbm=up_hbm))
         err = int(state.err)
         done = int(state.read_idx)
         if err == ERR_OK and done >= n_reads:
@@ -1784,7 +1807,7 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     record_paths = bool(abpt.use_read_ids)
     amb = bool(abpt.amb_strand)
     if use_pallas:
-        from .pallas_fused import fits_vmem
+        from .pallas_fused import fits_vmem, fits_vmem_local_hbm
 
     def init_one():
         return init_fused_state(N, E, A,
@@ -1801,12 +1824,15 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
         inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
         up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
                                       m=abpt.m, Qp=Qp)
+        up_hbm = (use_pallas and not up and local_m
+                  and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
+                                          m=abpt.m, Qp=Qp))
 
         kwargs = _static_chunk_kwargs(
             abpt, W=W, max_ops=max_ops, plane16=plane16,
             int16_limit=int16_limit, use_pallas=up,
             pl_interpret=pl_interpret, record_paths=record_paths,
-            amb=amb, local_m=local_m)
+            amb=amb, local_m=local_m, pallas_hbm=up_hbm)
 
         def chunk_one(st, sq, wg, ln, nr, qp):
             return run_fused_chunk(

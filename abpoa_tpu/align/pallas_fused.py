@@ -56,6 +56,75 @@ UNROLL_K = 8
 _M_BASE, _M_NPRE, _M_NOUT, _M_REMAIN, _M_TAB = 0, 1, 2, 3, 4
 
 
+def _row_dp_math(gap_mode, local, col, inf, neg_row, chain,
+                 e1, oe1, e2, oe2, ext1_ref, ext2_ref):
+    """Regime DP math for ONE row, shared by the VMEM-ring kernel and the
+    HBM-resident local kernel: given the gathered predecessor maxima
+    (Mq pre-qp, E1r, E2r), produce the five plane rows. Mirrors
+    fused_loop._dp_banded row for row; reference lg/ag/cg kernels
+    /root/reference/src/abpoa_align_simd.c:727-1074."""
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+
+    def math(Mq, E1r, E2r, in_band):
+        if linear:
+            # lg regime: Erow = max over preds of H[pre][j] - e1;
+            # H row is an in-row gap chain over max(M, E)
+            # (fused_loop._dp_banded linear branch; reference
+            # simd_abpoa_lg_dp :727-815)
+            Erow = jnp.where(in_band, E1r - e1, inf)
+            Hhat = jnp.maximum(Mq, Erow)
+            Hrow = chain(Hhat, ext1_ref)
+            if local:
+                Hrow = jnp.maximum(Hrow, 0)
+            Hrow = jnp.where(in_band, Hrow, inf)
+            E1n = E2n = F1 = F2 = neg_row
+            return Hrow, E1n, E2n, F1, F2
+        E1r = jnp.where(in_band, E1r, inf)
+        Hhat = jnp.maximum(Mq, E1r)
+        if convex:
+            E2r = jnp.where(in_band, E2r, inf)
+            Hhat = jnp.maximum(Hhat, E2r)
+        Hm1 = jnp.where(col >= 1, roll_any(Hhat, 1), inf)
+        A1 = jnp.where(in_band,
+                       jnp.where(col == 0, Mq - oe1, Hm1 - oe1),
+                       inf)
+        F1 = chain(A1, ext1_ref)
+        Hrow = jnp.maximum(Hhat, F1)
+        if convex:
+            A2 = jnp.where(in_band,
+                           jnp.where(col == 0, Mq - oe2, Hm1 - oe2), inf)
+            F2 = chain(A2, ext2_ref)
+            Hrow = jnp.maximum(Hrow, F2)
+            if local:  # clamp BEFORE deriving E (oracle order)
+                Hrow = jnp.maximum(Hrow, 0)
+            E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+            E2n = jnp.maximum(E2r - e2, Hrow - oe2)
+            if local:
+                E1n = jnp.maximum(E1n, 0)
+                E2n = jnp.maximum(E2n, 0)
+        else:
+            F2 = neg_row
+            if local:
+                Hrow = jnp.maximum(Hrow, 0)
+            # ag regime gates E on H == Hhat (reference simd_abpoa_ag_dp
+            # :817-933; affine branch); the killed-E value is 0 in local
+            E1n = jnp.maximum(E1r - e1, Hrow - oe1)
+            W = col.shape[1]
+            E1n = jnp.where(Hrow == Hhat, E1n,
+                            jnp.zeros((1, W), jnp.int32)
+                            if local else inf)
+            E2n = neg_row
+        Hrow = jnp.where(in_band, Hrow, inf)
+        E1n = jnp.where(in_band, E1n, inf)
+        E2n = jnp.where(in_band, E2n, inf)
+        F1 = jnp.where(in_band, F1, inf)
+        F2 = jnp.where(in_band, F2, inf)
+        return Hrow, E1n, E2n, F1, F2
+
+    return math
+
+
 def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                  K: int, extend: bool = False, zdrop_on: bool = False,
                  local: bool = False):
@@ -251,60 +320,10 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
                 qprow = qp_band_row(qp_ref, base_v, beg, W)
                 Mq = jnp.where(in_band, Mq + qprow, inf)
 
-                if linear:
-                    # lg regime: Erow = max over preds of H[pre][j] - e1;
-                    # H row is an in-row gap chain over max(M, E)
-                    # (fused_loop._dp_banded linear branch; reference
-                    # simd_abpoa_lg_dp :727-815)
-                    Erow = jnp.where(in_band, E1r - e1, inf)
-                    Hhat = jnp.maximum(Mq, Erow)
-                    Hrow = chain(Hhat, sc_ref[4])
-                    if local:
-                        Hrow = jnp.maximum(Hrow, 0)
-                    Hrow = jnp.where(in_band, Hrow, inf)
-                    E1n = E2n = F1 = F2 = neg_row
-                else:
-                    E1r = jnp.where(in_band, E1r, inf)
-                    Hhat = jnp.maximum(Mq, E1r)
-                    if convex:
-                        E2r = jnp.where(in_band, E2r, inf)
-                        Hhat = jnp.maximum(Hhat, E2r)
-                    Hm1 = jnp.where(col >= 1, roll_any(Hhat, 1), inf)
-                    A1 = jnp.where(in_band,
-                                   jnp.where(col == 0, Mq - oe1, Hm1 - oe1),
-                                   inf)
-                    F1 = chain(A1, sc_ref[4])
-                    Hrow = jnp.maximum(Hhat, F1)
-                    if convex:
-                        A2 = jnp.where(in_band,
-                                       jnp.where(col == 0, Mq - oe2,
-                                                 Hm1 - oe2), inf)
-                        F2 = chain(A2, sc_ref[6])
-                        Hrow = jnp.maximum(Hrow, F2)
-                        if local:  # clamp BEFORE deriving E (oracle order)
-                            Hrow = jnp.maximum(Hrow, 0)
-                        E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-                        E2n = jnp.maximum(E2r - e2, Hrow - oe2)
-                        if local:
-                            E1n = jnp.maximum(E1n, 0)
-                            E2n = jnp.maximum(E2n, 0)
-                    else:
-                        F2 = neg_row
-                        if local:
-                            Hrow = jnp.maximum(Hrow, 0)
-                        # ag regime gates E on H == Hhat (reference
-                        # simd_abpoa_ag_dp :817-933; affine branch); the
-                        # killed-E value is 0 in local mode
-                        E1n = jnp.maximum(E1r - e1, Hrow - oe1)
-                        E1n = jnp.where(Hrow == Hhat, E1n,
-                                        jnp.zeros((1, W), jnp.int32)
-                                        if local else inf)
-                        E2n = neg_row
-                    Hrow = jnp.where(in_band, Hrow, inf)
-                    E1n = jnp.where(in_band, E1n, inf)
-                    E2n = jnp.where(in_band, E2n, inf)
-                    F1 = jnp.where(in_band, F1, inf)
-                    F2 = jnp.where(in_band, F2, inf)
+                math = _row_dp_math(gap_mode, local, col, inf, neg_row,
+                                    chain, e1, oe1, e2, oe2,
+                                    sc_ref[4], sc_ref[6])
+                Hrow, E1n, E2n, F1, F2 = math(Mq, E1r, E2r, in_band)
 
                 ringH[row % D, :] = Hrow[0]
                 if not linear:
@@ -419,9 +438,241 @@ def _make_kernel(W: int, P: int, O: int, D: int, gap_mode: int, plane16: bool,
     return kernel
 
 
+def _make_local_hbm_kernel(W: int, P: int, gap_mode: int, plane16: bool):
+    """Local-mode kernel for band widths past the VMEM ring budget
+    (10 kb+ reads): the plane OUTPUTS in HBM double as the row history —
+    the reference's own storage plan (the full DP matrix lives in DRAM,
+    src/abpoa_simd.c:52-83) — and each row DMAs just its predecessors'
+    rows into small VMEM scratch buffers. No rings, so there is no
+    predecessor-distance limit and ok is always 1; rows are full-width
+    (local disables banding, src/abpoa_align.c:167), so all plane rows
+    share column origin 0 and pred reads need no band realignment."""
+    linear = gap_mode == C.LINEAR_GAP
+    convex = gap_mode == C.CONVEX_GAP
+    dt = jnp.int16 if plane16 else jnp.int32
+    B = BLOCK_B
+
+    def kernel(sc_ref, meta_ref, row0H_ref, row0E1_ref, row0E2_ref, qp_ref,
+               H_out, E1_out, E2_out, F1_out, F2_out, beg_out, end_out,
+               ok_out, ext_out, *scratch):
+        best_s = scratch[-1]
+        (predH, predE1, predE2, rowbufH, rowbufE1, rowbufE2,
+         rowbufF1, rowbufF2, smeta, sem, wsem) = scratch[:-1]
+        row = pl.program_id(0)
+        n_steps = pl.num_programs(0)
+        sub = row % B
+        qlen = sc_ref[0]
+        inf = sc_ref[3]
+        e1, oe1 = sc_ref[4], sc_ref[5]
+        e2, oe2 = sc_ref[6], sc_ref[7]
+        gn = sc_ref[8]
+
+        col = lax.broadcasted_iota(jnp.int32, (1, W), 1)
+        neg_row = jnp.full((1, W), inf, jnp.int32)
+
+        def chain(A, ext32):
+            F = A
+            shift = 1
+            while shift < W:
+                rolled = roll_any(F, shift)
+                prev = jnp.where(col >= shift, rolled, inf)
+                clampv = jnp.full((1, W), inf + shift * ext32, jnp.int32)
+                subv = jnp.full((1, W), shift * ext32, jnp.int32)
+                F = jnp.maximum(F, jnp.maximum(prev, clampv) - subv)
+                shift <<= 1
+            return F
+
+        @pl.when(row == 0)
+        def _init():
+            best_s[0] = inf
+            best_s[1] = 0
+            best_s[2] = 0
+            # row 0 planes land in HBM so row 1+ can DMA them back like any
+            # other predecessor row
+            for o, r0 in ((H_out, row0H_ref), (E1_out, row0E1_ref),
+                          (E2_out, row0E2_ref)):
+                rowbufH[0, :] = r0[0, :].astype(dt)
+                cp = pltpu.make_async_copy(
+                    rowbufH.at[pl.ds(0, 1)], o.at[pl.ds(0, 1)], wsem)
+                cp.start()
+                cp.wait()
+            rowbufH[0, :] = neg_row[0].astype(dt)
+            for o in (F1_out, F2_out):
+                cp = pltpu.make_async_copy(
+                    rowbufH.at[pl.ds(0, 1)], o.at[pl.ds(0, 1)], wsem)
+                cp.start()
+                cp.wait()
+
+        @pl.when(row % B == 0)
+        def _load_meta():
+            cp = pltpu.make_async_copy(meta_ref, smeta, sem)
+            cp.start()
+            cp.wait()
+
+        active = (row >= 1) & (row < gn - 1)
+
+        @pl.when(active)
+        def _row():
+            b_packed = smeta[sub, _M_BASE]
+            base_v = b_packed & 0xFF
+            npre = smeta[sub, _M_NPRE]
+            in_band = col <= qlen
+
+            def pred_body(k, acc):
+                Mq, E1r, E2r = acc
+                p = smeta[sub, _M_TAB + k]
+                cp = pltpu.make_async_copy(
+                    H_out.at[pl.ds(p, 1)], predH, sem)
+                cp.start()
+                cp.wait()
+                hrow = predH[0, :][None].astype(jnp.int32)
+                hs = jnp.where(col >= 1, roll_any(hrow, 1), 0)
+                # absolute col-1 == -1 is the lead cell, score 0 in local
+                Mq = jnp.maximum(Mq, jnp.where(col == 0, 0, hs))
+                if linear:
+                    E1r = jnp.maximum(E1r, hrow)
+                else:
+                    cp = pltpu.make_async_copy(
+                        E1_out.at[pl.ds(p, 1)], predE1, sem)
+                    cp.start()
+                    cp.wait()
+                    E1r = jnp.maximum(E1r, predE1[0, :][None]
+                                      .astype(jnp.int32))
+                    if convex:
+                        cp = pltpu.make_async_copy(
+                            E2_out.at[pl.ds(p, 1)], predE2, sem)
+                        cp.start()
+                        cp.wait()
+                        E2r = jnp.maximum(E2r, predE2[0, :][None]
+                                          .astype(jnp.int32))
+                return (Mq, E1r, E2r)
+
+            Mq, E1r, E2r = lax.fori_loop(
+                0, npre, pred_body, (neg_row, neg_row, neg_row))
+
+            qprow = qp_band_row(qp_ref, base_v, jnp.int32(0), W)
+            Mq = jnp.where(in_band, Mq + qprow, inf)
+
+            math = _row_dp_math(gap_mode, True, col, inf, neg_row,
+                                chain, e1, oe1, e2, oe2,
+                                sc_ref[4], sc_ref[6])
+            Hrow, E1n, E2n, F1, F2 = math(Mq, E1r, E2r, in_band)
+
+            for buf, val in ((rowbufH, Hrow), (rowbufE1, E1n),
+                             (rowbufE2, E2n), (rowbufF1, F1),
+                             (rowbufF2, F2)):
+                buf[0, :] = val[0].astype(dt)
+            for buf, o in ((rowbufH, H_out), (rowbufE1, E1_out),
+                           (rowbufE2, E2_out), (rowbufF1, F1_out),
+                           (rowbufF2, F2_out)):
+                cp = pltpu.make_async_copy(
+                    buf.at[pl.ds(0, 1)], o.at[pl.ds(row, 1)], wsem)
+                cp.start()
+                cp.wait()
+
+            left, right, mx, has_row = band_extents(Hrow, in_band, col,
+                                                    sc_ref[3])
+            bs = best_s[0]
+            better = mx > bs
+            best_s[0] = jnp.where(better, mx, bs)
+            best_s[1] = jnp.where(better, row, best_s[1])
+            best_s[2] = jnp.where(better, left, best_s[2])
+
+        beg_out[pl.ds(sub, 1), :] = jnp.zeros((1, 1), jnp.int32)
+        end_out[pl.ds(sub, 1), :] = jnp.full((1, 1), qlen, jnp.int32)
+
+        @pl.when(row == n_steps - 1)
+        def _flush():
+            ok_out[0] = 1
+            ext_out[0] = best_s[0]
+            ext_out[1] = best_s[1]
+            ext_out[2] = best_s[2]
+            ext_out[3] = 0
+
+    return kernel
+
+
 def meta_lanes(P: int, O: int) -> int:
     """Packed per-row metadata width, rounded up to full 128-lane registers."""
     return -(-(_M_TAB + P + O) // 128) * 128
+
+
+def fits_vmem_local_hbm(W: int, gap_mode: int, plane16: bool,
+                        m: int = 32, Qp: int = 0) -> bool:
+    """VMEM working set of the HBM-resident local kernel: 8 single-row
+    scratch buffers + the resident query profile + streamed beg/end blocks.
+    Scales with W (one row), not D x W (the ring) — a 10 kb local read
+    (W=16384) needs ~1.2 MB of rows + ~650 KB of profile."""
+    itemsize = 2 if plane16 else 4
+    row_bytes = 8 * W * itemsize
+    qp_bytes = m * (Qp + W) * 4
+    blk_bytes = 2 * 2 * BLOCK_B * 4  # beg/end (B,1) blocks, double-buffered
+    return row_bytes + qp_bytes + blk_bytes <= 11 * 2**20
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "R", "W", "P", "O", "gap_mode", "plane16", "interpret"))
+def pallas_fused_dp_local_hbm(scalars, base_packed, pre_idx, pre_cnt,
+                              out_idx, out_cnt, remain_rows,
+                              row0H, row0E1, row0E2, qp_pad,
+                              R: int, W: int, P: int, O: int,
+                              gap_mode: int = C.CONVEX_GAP,
+                              plane16: bool = False,
+                              interpret: bool = False):
+    """Local-mode forward DP with HBM-resident plane history (see
+    _make_local_hbm_kernel). Same signature contract as pallas_fused_dp
+    restricted to local mode; ok is always 1."""
+    B = BLOCK_B
+    dt = jnp.int16 if plane16 else jnp.int32
+    kernel = _make_local_hbm_kernel(W, P, gap_mode, plane16)
+    m = qp_pad.shape[0]
+    L = meta_lanes(P, O)
+    meta = jnp.concatenate(
+        [base_packed[:, None], pre_cnt[:, None], out_cnt[:, None],
+         remain_rows[:, None], pre_idx, out_idx], axis=1)
+    meta = jnp.pad(meta, ((0, 0), (0, L - meta.shape[1])))
+    out_shapes = (
+        [jax.ShapeDtypeStruct((R, W), dt)] * 5
+        + [jax.ShapeDtypeStruct((R, 1), jnp.int32),
+           jax.ShapeDtypeStruct((R, 1), jnp.int32),
+           jax.ShapeDtypeStruct((1,), jnp.int32),
+           jax.ShapeDtypeStruct((4,), jnp.int32)])
+    blk1 = pl.BlockSpec((B, 1), lambda g: (g // B, 0),
+                        memory_space=pltpu.VMEM)
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    out_specs = [any_spec] * 5 + [
+        blk1, blk1,
+        pl.BlockSpec((1,), lambda g: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((4,), lambda g: (0,), memory_space=pltpu.SMEM)]
+    in_specs = [
+        pl.BlockSpec((16,), lambda g: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((B, L), lambda g: (g // B, 0),
+                     memory_space=pltpu.VMEM),  # DMAed into SMEM per block
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, W), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, qp_pad.shape[1]), lambda g: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    scratch = (
+        [pltpu.VMEM((1, W), dt)] * 3      # pred H/E1/E2 fetch buffers
+        + [pltpu.VMEM((1, W), dt)] * 5    # row output staging H/E1/E2/F1/F2
+        + [pltpu.SMEM((B, L), jnp.int32),  # metadata block
+           pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+           pltpu.SMEM((5,), jnp.int32)])   # best-cell state
+    fn = pl.pallas_call(
+        kernel,
+        grid=(R,),
+        out_shape=out_shapes,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+    (H, E1, E2, F1, F2, beg, end, ok, ext) = fn(
+        scalars, meta, row0H.astype(jnp.int32), row0E1.astype(jnp.int32),
+        row0E2.astype(jnp.int32), qp_pad)
+    return H, E1, E2, F1, F2, beg[:, 0], end[:, 0], ok, ext
 
 
 def fits_vmem(W: int, gap_mode: int, plane16: bool,

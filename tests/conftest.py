@@ -40,3 +40,79 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+# ---- background accelerator probe --------------------------------------- #
+# The compiled-on-chip tests need to know whether a real accelerator
+# answers. Probing lazily at test time used to cost a full 90 s timeout of
+# dead wall time per cold suite on a wedged tunnel. Instead the probe child
+# starts at COLLECTION time — and only when an on-chip test was actually
+# collected — so by the time those tests ask (minutes into the run) the
+# answer is ready at zero added wall-clock, and selections with no on-chip
+# test never spawn it.
+#
+# State lives on `sys` (not this module): pytest loads this file as
+# top-level `conftest` while test files import `tests.conftest` — TWO
+# module instances. A module-global here would spawn two probe children,
+# and on a real TPU host the second child's backend init fails against the
+# first's exclusive chip lock, mis-answering "no accelerator".
+import atexit
+import subprocess
+import time
+
+_PROBE_DEADLINE_S = 90
+_PROBE_KEY = "_abpoa_tpu_probe_state"
+
+
+def _start_accelerator_probe():
+    if getattr(sys, _PROBE_KEY, None) is not None:
+        return
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the real platform win in the child
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('acc' if any(x.platform!='cpu' for x in d) else 'cpu')"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, start_new_session=True)
+        setattr(sys, _PROBE_KEY,
+                {"proc": proc, "started": time.time(), "answer": None})
+    except Exception:
+        setattr(sys, _PROBE_KEY, {"proc": None, "started": 0.0,
+                                  "answer": False})
+
+
+def _kill_probe():
+    st = getattr(sys, _PROBE_KEY, None)
+    if st and st["proc"] is not None and st["proc"].poll() is None:
+        try:
+            st["proc"].kill()
+        except Exception:
+            pass
+
+
+def accelerator_reachable() -> bool:
+    """True iff the probe child saw a non-CPU platform. Blocks only for
+    whatever remains of the 90 s budget that started at collection (or
+    starts the probe now if no on-chip test was collected this run)."""
+    _start_accelerator_probe()  # no-op when already started
+    st = getattr(sys, _PROBE_KEY)
+    if st["answer"] is not None:
+        return st["answer"]
+    remaining = max(1.0, _PROBE_DEADLINE_S - (time.time() - st["started"]))
+    try:
+        out, _ = st["proc"].communicate(timeout=remaining)
+        st["answer"] = st["proc"].returncode == 0 and "acc" in out
+    except subprocess.TimeoutExpired:
+        _kill_probe()
+        st["answer"] = False
+    return st["answer"]
+
+
+def pytest_collection_modifyitems(config, items):
+    if any("compiled_on_chip" in item.nodeid for item in items):
+        _start_accelerator_probe()
+
+
+atexit.register(_kill_probe)
