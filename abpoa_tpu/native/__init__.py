@@ -105,5 +105,7 @@ def load():
         c.c_void_p, c.c_int, c.c_int, u8p, c.c_int, i32p, i32p,
         u64p, c.c_int, i64p]
     lib.apg_align.restype = c.c_int
+    lib.apg_cons_hb.argtypes = [c.c_void_p, i32p, i32p, i32p, c.c_int]
+    lib.apg_cons_hb.restype = c.c_int
     _lib = lib
     return lib

@@ -711,6 +711,79 @@ extern "C" {
 //                         put_gap_at_end, ret_cigar]
 // meta out (int64): [best_score, node_s, node_e, query_s, query_e,
 //                    n_aln_bases, n_matched_bases, n_cigar]
+int apg_cons_hb(void* h, int32_t* ids_out, int32_t* base_out,
+                int32_t* cov_out, int cap) {
+    // Heaviest-bundling consensus, single cluster / read-count weights (the
+    // default -r0 config): reverse BFS from sink, per-node argmax out-edge
+    // weight with path-score tiebreak, then walk max_out from source
+    // (reference abpoa_heaviest_bundling src/abpoa_output.c:478-548, walk
+    // :376-392). Multi-cluster / qv-weighted calls stay on the Python side
+    // (they need per-edge read-id bitsets).
+    Graph& g = *(Graph*)h;
+    const int n = g.n();
+    if (n <= 2) return 0;
+    const int src = 0, sink = 1;
+    // int64 scores: the Python path accumulates in unbounded ints, and a
+    // qv-weighted long-path sum can exceed int32
+    std::vector<int64_t> score(n, 0);
+    std::vector<int32_t> max_out(n, -1), out_deg(n);
+    for (int i = 0; i < n; ++i) out_deg[i] = (int)g.nodes[i].out_ids.size();
+    std::vector<int32_t>& q = g.ws_queue;
+    if ((int)q.size() < n) q.resize(n);
+    int head = 0, tail = 0;
+    q[tail++] = sink;
+    while (head < tail) {
+        const int cur = q[head++];
+        const Node& node = g.nodes[cur];
+        if (cur == sink) {
+            score[cur] = 0;
+        } else if (cur == src) {
+            int64_t path_score = -1;
+            int32_t path_max_w = -1;
+            int max_id = -1;
+            for (size_t i = 0; i < node.out_ids.size(); ++i) {
+                const int out_id = node.out_ids[i];
+                const int32_t out_w = node.out_w[i];
+                if (out_w > path_max_w
+                        || (out_w == path_max_w && score[out_id] > path_score)) {
+                    max_id = out_id;
+                    path_score = score[out_id];
+                    path_max_w = out_w;
+                }
+            }
+            max_out[cur] = max_id;
+            break;
+        } else {
+            int32_t max_w = INT32_MIN;
+            int max_id = -1;
+            for (size_t i = 0; i < node.out_ids.size(); ++i) {
+                const int out_id = node.out_ids[i];
+                const int32_t out_w = node.out_w[i];
+                if (max_w < out_w) {
+                    max_w = out_w;
+                    max_id = out_id;
+                } else if (max_w == out_w && score[max_id] <= score[out_id]) {
+                    max_id = out_id;
+                }
+            }
+            score[cur] = max_w + score[max_id];
+            max_out[cur] = max_id;
+        }
+        for (int in_id : node.in_ids)
+            if (--out_deg[in_id] == 0) q[tail++] = in_id;
+    }
+    int len = 0;
+    for (int cur = max_out[src]; cur != sink; cur = max_out[cur]) {
+        if (len >= cap) return -1;  // caller resizes and retries
+        ids_out[len] = cur;
+        base_out[len] = g.nodes[cur].base;
+        cov_out[len] = g.nodes[cur].n_read;
+        ++len;
+    }
+    return len;
+}
+
+
 int apg_align(void* h, int beg_node_id, int end_node_id,
               const uint8_t* query, int qlen, const int32_t* mat,
               const int32_t* params, uint64_t* cigar_out, int cigar_cap,

@@ -260,8 +260,40 @@ def _msa_inner(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
     output(ab, abpt, out_fp)
 
 
+def _native_cons_fast_path(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> bool:
+    """Default consensus output straight from the native graph (C++
+    heaviest bundling, native/host_core.cpp apg_cons_hb): skips the O(V+E)
+    to_python export, which dominated short-read-set wall time. Covers the
+    single-cluster read-count-weight config only; everything else falls
+    through to the Python consensus over the exported graph."""
+    g = ab.graph
+    if (not getattr(g, "is_native", False)
+            or abpt.out_msa or abpt.out_gfa or not abpt.out_cons
+            or abpt.out_pog or abpt.cons_algrm != C.CONS_HB
+            or abpt.max_n_cons > 1):
+        return False
+    abc = ConsensusResult(n_seq=ab.n_seq)
+    if g.node_n > 2:
+        from .cons.consensus import phred_score_vec
+        ids, bases, covs = g.consensus_hb()
+        abc.n_cons = 1
+        abc.clu_n_seq = [ab.n_seq]
+        abc.clu_read_ids = [list(range(ab.n_seq))]
+        abc.cons_node_ids = [ids.tolist()]
+        abc.cons_base = [bases.tolist()]
+        abc.cons_cov = [covs.tolist()]
+        abc.cons_phred = [phred_score_vec(covs, ab.n_seq).tolist()]
+    else:
+        print("Warning: no consensus sequence generated.", file=sys.stderr)
+    ab.cons = abc
+    output_fx_consensus(abc, abpt, out_fp)
+    return True
+
+
 def output(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> None:
     """(src/abpoa_align.c:355-371)"""
+    if _native_cons_fast_path(ab, abpt, out_fp):
+        return
     g = ab.graph
     if getattr(g, "is_native", False):
         g = g.to_python(abpt)  # output-time consumers walk Python nodes
