@@ -239,6 +239,35 @@ def most_frequent(g: POAGraph, abpt: Params, n_clu: int,
         abc.cons_phred.append(phreds)
 
 
+def native_hb_eligible(g, abpt: Params) -> bool:
+    """True when the C++ heaviest-bundling fast path covers this config:
+    native graph, single cluster, HB algorithm, consensus requested.
+    Callers add their own output-mode exclusions (gfa/pog) on top."""
+    return (getattr(g, "is_native", False)
+            and abpt.out_cons and not abpt.out_msa
+            and abpt.cons_algrm == C.CONS_HB
+            and abpt.max_n_cons == 1)
+
+
+def native_consensus_hb(g, n_seq: int) -> ConsensusResult:
+    """ConsensusResult straight from the native graph's C++ heaviest
+    bundling (native/host_core.cpp apg_cons_hb) — the default single-
+    cluster read-count-weight config, skipping the O(V+E) to_python
+    export. Callers gate on that config themselves."""
+    abc = ConsensusResult(n_seq=n_seq)
+    if g.node_n <= 2:
+        return abc
+    ids, bases, covs = g.consensus_hb()
+    abc.n_cons = 1
+    abc.clu_n_seq = [n_seq]
+    abc.clu_read_ids = [list(range(n_seq))]
+    abc.cons_node_ids = [ids.tolist()]
+    abc.cons_base = [bases.tolist()]
+    abc.cons_cov = [covs.tolist()]
+    abc.cons_phred = [phred_score_vec(covs, n_seq).tolist()]
+    return abc
+
+
 def generate_consensus(g: POAGraph, abpt: Params, n_seq: int) -> ConsensusResult:
     """Driver (src/abpoa_output.c:1184-1215)."""
     abc = ConsensusResult(n_seq=n_seq)

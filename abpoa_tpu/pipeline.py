@@ -267,23 +267,11 @@ def _native_cons_fast_path(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> bool:
     single-cluster read-count-weight config only; everything else falls
     through to the Python consensus over the exported graph."""
     g = ab.graph
-    if (not getattr(g, "is_native", False)
-            or abpt.out_msa or abpt.out_gfa or not abpt.out_cons
-            or abpt.out_pog or abpt.cons_algrm != C.CONS_HB
-            or abpt.max_n_cons > 1):
+    from .cons.consensus import native_consensus_hb, native_hb_eligible
+    if not native_hb_eligible(g, abpt) or abpt.out_gfa or abpt.out_pog:
         return False
-    abc = ConsensusResult(n_seq=ab.n_seq)
-    if g.node_n > 2:
-        from .cons.consensus import phred_score_vec
-        ids, bases, covs = g.consensus_hb()
-        abc.n_cons = 1
-        abc.clu_n_seq = [ab.n_seq]
-        abc.clu_read_ids = [list(range(ab.n_seq))]
-        abc.cons_node_ids = [ids.tolist()]
-        abc.cons_base = [bases.tolist()]
-        abc.cons_cov = [covs.tolist()]
-        abc.cons_phred = [phred_score_vec(covs, ab.n_seq).tolist()]
-    else:
+    abc = native_consensus_hb(g, ab.n_seq)
+    if abc.n_cons == 0:
         print("Warning: no consensus sequence generated.", file=sys.stderr)
     ab.cons = abc
     output_fx_consensus(abc, abpt, out_fp)

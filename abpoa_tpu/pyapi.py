@@ -110,15 +110,19 @@ class msa_aligner:
         if ab is None:
             ab = self.ab
         g = ab.graph
-        if getattr(g, "is_native", False):
-            g = g.to_python(abpt)
-        if abpt.out_msa:
-            abc = generate_rc_msa(g, abpt, n_seq)
-        elif abpt.out_cons:
-            abc = generate_consensus(g, abpt, n_seq)
+        from .cons.consensus import native_consensus_hb, native_hb_eligible
+        if native_hb_eligible(g, abpt):
+            abc = native_consensus_hb(g, n_seq)
         else:
-            from .cons.consensus import ConsensusResult
-            abc = ConsensusResult(n_seq=n_seq)
+            if getattr(g, "is_native", False):
+                g = g.to_python(abpt)
+            if abpt.out_msa:
+                abc = generate_rc_msa(g, abpt, n_seq)
+            elif abpt.out_cons:
+                abc = generate_consensus(g, abpt, n_seq)
+            else:
+                from .cons.consensus import ConsensusResult
+                abc = ConsensusResult(n_seq=n_seq)
         decode = abpt.code_to_char
         cons_seq = ["".join(chr(decode[b]) for b in row) for row in abc.cons_base]
         cons_qv = ["".join(chr(q) for q in row) for row in abc.cons_phred]
