@@ -1506,6 +1506,18 @@ def _plan_buckets(abpt: Params, qmax: int) -> Tuple[int, int, bool]:
     return Qp, W, local_m
 
 
+def partition_by_length_bucket(entries):
+    """Group (key, seqs, weights) triples by the planner's Qp bucket
+    (_plan_buckets) so lockstep sub-batches share honest padding: a short
+    set must not pay a long set's shared planes. Returns the groups in
+    ascending bucket order."""
+    parts: dict = {}
+    for entry in entries:
+        qmax = max((len(s) for s in entry[1]), default=0)
+        parts.setdefault(_bucket(qmax + 2, 128), []).append(entry)
+    return [parts[k] for k in sorted(parts)]
+
+
 def _pad_read_set(seqs, weights, Qp: int, mat: np.ndarray, m: int):
     """-> (seqs_pad, wgts_pad, lens, qp) host arrays for one read set."""
     n = len(seqs)

@@ -56,18 +56,27 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
     if not group:
         return {}
     import jax
-    from ..align.fused_loop import progressive_poa_fused_batch
+    from ..align.fused_loop import (partition_by_length_bucket,
+                                    progressive_poa_fused_batch)
     results: dict = {}
     dev = devices[gi % len(devices)]
-    try:
-        with jax.default_device(dev):
-            outs = progressive_poa_fused_batch(
-                [e[2] for e in group], [e[3] for e in group], abpt)
-    except RuntimeError as e:
-        print(f"Warning: fused lockstep batch failed ({e}); "
-              "falling back to sequential processing.", file=sys.stderr)
-        return {}
-    for (idx, ab, _seqs, _w), res in zip(group, outs):
+    outs = []
+    flat = []
+    # same-Qp-bucket sub-batches keep the shared padding honest (a 100 bp
+    # set must not pay a 10 kb set's planes); a failed bucket falls back
+    # alone — completed buckets keep their device results
+    for sub in partition_by_length_bucket(
+            [(e[0], e[2], e[3], e[1]) for e in group]):
+        flat.extend(sub)
+        try:
+            with jax.default_device(dev):
+                outs.extend(progressive_poa_fused_batch(
+                    [e[1] for e in sub], [e[2] for e in sub], abpt))
+        except RuntimeError as e:
+            print(f"Warning: fused lockstep batch failed ({e}); "
+                  "falling back to sequential processing.", file=sys.stderr)
+            outs.extend([None] * len(sub))
+    for (idx, _seqs, _w, ab), res in zip(flat, outs):
         if res is None:
             continue
         pg, is_rc = res

@@ -241,12 +241,19 @@ class msa_aligner:
             enc_sets.append(bseqs)
             wgt_sets.append(wgts)
         if lockstep:
-            from .align.fused_loop import progressive_poa_fused_batch
-            try:
-                outs = progressive_poa_fused_batch(enc_sets, wgt_sets, abpt)
-            except RuntimeError:
-                outs = [None] * len(lockstep)
-            for k, res in zip(lockstep, outs):
+            from .align.fused_loop import (partition_by_length_bucket,
+                                           progressive_poa_fused_batch)
+            order, outs = [], []
+            # same-Qp-bucket sub-batches; a failed bucket falls back alone
+            for sub in partition_by_length_bucket(
+                    list(zip(lockstep, enc_sets, wgt_sets))):
+                order.extend(e[0] for e in sub)
+                try:
+                    outs.extend(progressive_poa_fused_batch(
+                        [e[1] for e in sub], [e[2] for e in sub], abpt))
+                except RuntimeError:
+                    outs.extend([None] * len(sub))
+            for k, res in zip(order, outs):
                 if res is None:
                     continue
                 pg, _is_rc = res

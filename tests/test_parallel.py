@@ -119,12 +119,14 @@ def test_run_batch_mixed_eligibility(tmp_path):
     from abpoa_tpu.pipeline import Abpoa, msa_from_file
 
     files = []
-    for s in range(2):
+    # deliberately different length buckets: the lockstep runner must
+    # partition them into same-bucket sub-batches and still emit in order
+    for s, rl in enumerate((120, 600)):
         p = str(tmp_path / f"mx{s}.fa")
         subprocess.run(
             [sys.executable,
              os.path.join(os.path.dirname(__file__), "make_sim.py"),
-             "--ref-len", "120", "--n-reads", "4", "--err", "0.1",
+             "--ref-len", str(rl), "--n-reads", "4", "--err", "0.1",
              "--seed", str(500 + s), "--out", p], check=True)
         files.append(p)
     single = str(tmp_path / "single.fa")
