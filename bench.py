@@ -73,10 +73,22 @@ def _time_run(device, path, warm=False):
 
 
 # wall-clock caps for accelerator runs: a slow/hung device path must not
-# stall the bench — the native number still gets reported. Kept tight enough
-# that the whole bench stays well under typical driver limits even when every
-# accelerator run times out.
+# stall the bench — the native number still gets reported. Worst case with a
+# tunnel that answers the probe then wedges: 420 + 1500 (jax/pallas rows) +
+# 900 (fused_cpu) + 1200 (lockstep) ~= 67 min of timeouts before the native
+# line prints; the native rows themselves run first-in-loop and unaffected.
 _JAX_TIMEOUT = {"sim2k": 420, "sim10k_500": 1500}
+
+
+def _child_line(cmd, prefix, timeout):
+    """Run a child, return the payload after `prefix` on stdout, or raise
+    with the stderr tail — the one pattern every subprocess row shares."""
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+    for line in proc.stdout.splitlines():
+        if line.startswith(prefix):
+            return line[len(prefix):]
+    raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
 
 
 def _time_run_subprocess(device, path, warm, timeout):
@@ -86,12 +98,7 @@ def _time_run_subprocess(device, path, warm, timeout):
         "import bench\n"
         "print('WALL', bench._time_run({device!r}, {path!r}, warm={warm}))\n"
     ).format(here=HERE, device=device, path=path, warm=warm)
-    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=timeout)
-    for line in proc.stdout.splitlines():
-        if line.startswith("WALL "):
-            return float(line.split()[1])
-    raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
+    return float(_child_line([sys.executable, "-c", code], "WALL ", timeout))
 
 
 def _time_run_cpu_fused(path, timeout=900):
@@ -173,6 +180,23 @@ def main():
     big_devices = [d for d in devices if d != "numpy"]
     _run_workload("sim10k_500", p10k, sim10k["n_reads"], big_devices, False,
                   per_backend, results)
+
+    if "jax" in devices:
+        # lockstep multi-set batching: the per-chip throughput lever for
+        # `-l`-shaped workloads (K sets per vmapped dispatch); reported in
+        # extra so the committed bench tracks the K-scaling claim whenever
+        # an accelerator answers
+        try:
+            mb = json.loads(_child_line(
+                [sys.executable, os.path.join(HERE, "tools",
+                                              "microbench_tpu.py"),
+                 "--task", "lockstep", "--device", "jax",
+                 "--lockstep-k", "8", "--n-reads", "30"],
+                "MB ", timeout=1200))
+            per_backend["lockstep_k8_30x10k"] = {
+                "jax": mb.get("reads_per_sec")}
+        except Exception as e:
+            print(f"[bench] lockstep row failed: {e}", file=sys.stderr)
 
     print(f"[bench] per-backend reads/s: {json.dumps(per_backend)}",
           file=sys.stderr)
