@@ -7,6 +7,7 @@ orchestrates, including -G path scores (reference abpoa_graph.c:429-437).
 from __future__ import annotations
 
 import ctypes
+import os
 
 import numpy as np
 
@@ -32,6 +33,11 @@ def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
         abpt.gap_ext2, abpt.min_mis, 1 if abpt.put_gap_on_right else 0,
         1 if abpt.put_gap_at_end else 0, 1 if abpt.ret_cigar else 0,
         1 if abpt.inc_path_score else 0,
+        # width selection inputs (the kernel picks int16 plane storage per
+        # the reference's score bound, abpoa_align_simd.c:1284-1302);
+        # ABPOA_TPU_NATIVE_I32=1 forces int32 planes (parity testing)
+        int(abpt.max_mat),
+        1 if os.environ.get("ABPOA_TPU_NATIVE_I32") else 0,
     ], dtype=np.int32)
     cap = 2 * qlen + g.node_n + 16
     cig = np.zeros(cap, dtype=np.uint64)

@@ -42,8 +42,12 @@ struct Graph {
     std::vector<uint8_t> edge_dirty;
     bool all_edges_dirty = true;
     // persistent DP workspaces (reused across alignments, like the
-    // reference's abpoa_simd_matrix_t)
+    // reference's abpoa_simd_matrix_t); int16 twins back the 16-bit
+    // plane-STORAGE mode (math stays int32; stores saturate low), selected
+    // per alignment by the reference's score-width bound
+    // (abpoa_align_simd.c:1284-1302)
     std::vector<int32_t> wsH, wsE1, wsE2, wsF1, wsF2;
+    std::vector<int16_t> wsH16, wsE116, wsE216, wsF116, wsF216;
     std::vector<int32_t> ws_qprof;  // per-alignment query profile (m x qlen+1)
     std::vector<int32_t> ws_pre, ws_pre_off;  // flattened per-row pred lists
     std::vector<int32_t> ws_pre_ps;  // -G path score per pred slot (CSR twin)
@@ -657,25 +661,56 @@ int apg_subgraph_nodes(void* h, int inc_beg, int inc_end, int32_t* out2) {
 namespace {
 
 const int32_t KINT32_MIN = INT32_MIN;
+const int32_t KINT16_MIN = INT16_MIN;
 
+// int16 plane storage: all DP arithmetic stays int32 (values are bounded by
+// the width-selection check below); only the PLANE arrays narrow, halving
+// the bandwidth that dominates the row loop. Stores saturate at INT16_MIN —
+// decayed -inf chains clamp instead of wrapping (the reference's saturating
+// SIMD subs give the same guarantee, simd_instruction.h) — and saturated
+// cells stay far below every reachable real score, so backtrack equalities
+// on the optimal path are unaffected.
+template <typename T> inline T clamp_store(int32_t v) { return (T)v; }
+template <> inline int16_t clamp_store<int16_t>(int32_t v) {
+    return (int16_t)std::max(v, (int32_t)INT16_MIN);
+}
+
+template <typename T> struct PlaneWS;
+template <> struct PlaneWS<int32_t> {
+    static std::vector<int32_t>& H(Graph& g) { return g.wsH; }
+    static std::vector<int32_t>& E1(Graph& g) { return g.wsE1; }
+    static std::vector<int32_t>& E2(Graph& g) { return g.wsE2; }
+    static std::vector<int32_t>& F1(Graph& g) { return g.wsF1; }
+    static std::vector<int32_t>& F2(Graph& g) { return g.wsF2; }
+};
+template <> struct PlaneWS<int16_t> {
+    static std::vector<int16_t>& H(Graph& g) { return g.wsH16; }
+    static std::vector<int16_t>& E1(Graph& g) { return g.wsE116; }
+    static std::vector<int16_t>& E2(Graph& g) { return g.wsE216; }
+    static std::vector<int16_t>& F1(Graph& g) { return g.wsF116; }
+    static std::vector<int16_t>& F2(Graph& g) { return g.wsF216; }
+};
+
+template <typename T>
 struct DpPlanes {
     // banded rows: row i occupies [row_ptr[i], row_ptr[i] + width_i)
     // views over the graph's persistent workspaces (no per-call allocation)
     std::vector<int64_t>& row_ptr;
     std::vector<int32_t>& beg;
     std::vector<int32_t>& end;
-    std::vector<int32_t>& H;
-    std::vector<int32_t>& E1;
-    std::vector<int32_t>& E2;
-    std::vector<int32_t>& F1;
-    std::vector<int32_t>& F2;
+    std::vector<T>& H;
+    std::vector<T>& E1;
+    std::vector<T>& E2;
+    std::vector<T>& F1;
+    std::vector<T>& F2;
     int64_t used = 0;
     int32_t inf = 0;
     int n_planes = 5;
 
     explicit DpPlanes(Graph& g)
         : row_ptr(g.ws_row_ptr), beg(g.ws_beg), end(g.ws_end),
-          H(g.wsH), E1(g.wsE1), E2(g.wsE2), F1(g.wsF1), F2(g.wsF2) {}
+          H(PlaneWS<T>::H(g)), E1(PlaneWS<T>::E1(g)), E2(PlaneWS<T>::E2(g)),
+          F1(PlaneWS<T>::F1(g)), F2(PlaneWS<T>::F2(g)) {}
 
     void start(int gn, int np) {
         n_planes = np;
@@ -701,9 +736,9 @@ struct DpPlanes {
         }
     }
 
-    inline int32_t get(const std::vector<int32_t>& P, int i, int j) const {
+    inline int32_t get(const std::vector<T>& P, int i, int j) const {
         if (j < beg[i] || j > end[i]) return inf;
-        return P[row_ptr[i] + (j - beg[i])];
+        return (int32_t)P[row_ptr[i] + (j - beg[i])];
     }
     inline int32_t h(int i, int j) const { return get(H, i, j); }
     inline int32_t e1(int i, int j) const { return get(E1, i, j); }
@@ -736,13 +771,58 @@ struct CigBuf {
 
 }  // namespace
 
+template <typename T>
+int apg_align_core(void* h, int beg_node_id, int end_node_id,
+                   const uint8_t* query, int qlen, const int32_t* mat,
+                   const int32_t* params, uint64_t* cigar_out, int cigar_cap,
+                   int64_t* meta);
+
 extern "C" {
 
 // params layout (int32): [align_mode, gap_mode, wb, wf_x1e6, zdrop, m,
 //                         o1, e1, o2, e2, min_mis, put_gap_on_right,
-//                         put_gap_at_end, ret_cigar]
+//                         put_gap_at_end, ret_cigar, inc_path_score,
+//                         max_mat, force_int32_planes]
 // meta out (int64): [best_score, node_s, node_e, query_s, query_e,
 //                    n_aln_bases, n_matched_bases, n_cigar]
+int apg_align(void* h, int beg_node_id, int end_node_id,
+              const uint8_t* query, int qlen, const int32_t* mat,
+              const int32_t* params, uint64_t* cigar_out, int cigar_cap,
+              int64_t* meta) {
+    // score-width selection (reference simd_abpoa_align_sequence_to_subgraph,
+    // abpoa_align_simd.c:1284-1302): int16 plane STORAGE while the worst-case
+    // score bound fits, int32 after. Both widths produce identical output —
+    // the bound guarantees every reachable value fits int16, and saturated
+    // -inf cells stay below every real score.
+    Graph& g = *(Graph*)h;
+    const int32_t o1 = params[6], e1 = params[7];
+    const int32_t e2 = params[9];
+    const int32_t oe1 = o1 + e1, oe2 = params[8] + e2;
+    const int32_t min_mis = params[10];
+    const int32_t max_mat = params[15];
+    const bool force32 = params[16] != 0;
+    const int beg_index = g.node_id_to_index[beg_node_id];
+    const int end_index = g.node_id_to_index[end_node_id];
+    const int32_t gn = end_index - beg_index + 1;
+    const int32_t ln = std::max((int32_t)qlen, gn);
+    const int64_t bound = std::max((int64_t)qlen * max_mat,
+                                   (int64_t)ln * e1 + o1);
+    // the int16 inf sentinel is INT16_MIN + max(min_mis, oe1, oe2) +
+    // 512*max(e1,e2) (underflow headroom, apg_align_core); the limit must
+    // leave that same headroom below the most negative reachable score or
+    // inf could rise into — or above — the valid range (large extension
+    // penalties then simply select int32)
+    const int64_t limit = 32767 - min_mis - oe1 - oe2
+        - 512 * (int64_t)std::max(e1, e2);
+    if (!force32 && bound <= limit)
+        return apg_align_core<int16_t>(h, beg_node_id, end_node_id, query,
+                                       qlen, mat, params, cigar_out,
+                                       cigar_cap, meta);
+    return apg_align_core<int32_t>(h, beg_node_id, end_node_id, query, qlen,
+                                   mat, params, cigar_out, cigar_cap, meta);
+}
+
+
 int apg_cons_hb(void* h, int32_t* ids_out, int32_t* base_out,
                 int32_t* cov_out, int cap) {
     // Heaviest-bundling consensus, single cluster / read-count weights (the
@@ -816,10 +896,14 @@ int apg_cons_hb(void* h, int32_t* ids_out, int32_t* base_out,
 }
 
 
-int apg_align(void* h, int beg_node_id, int end_node_id,
-              const uint8_t* query, int qlen, const int32_t* mat,
-              const int32_t* params, uint64_t* cigar_out, int cigar_cap,
-              int64_t* meta) {
+}  // extern "C"
+
+// templates cannot carry C linkage; apg_align above is the C-ABI dispatcher
+template <typename T>
+int apg_align_core(void* h, int beg_node_id, int end_node_id,
+                   const uint8_t* query, int qlen, const int32_t* mat,
+                   const int32_t* params, uint64_t* cigar_out, int cigar_cap,
+                   int64_t* meta) {
     Graph& g = *(Graph*)h;
     const int align_mode = params[0], gap_mode = params[1], wb = params[2];
     const double wf = params[3] / 1e6;
@@ -839,8 +923,9 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     const int end_index = g.node_id_to_index[end_node_id];
     const int gn = end_index - beg_index + 1;
     const int w = banded ? wb + (int)(wf * qlen) : qlen;
-    const int32_t inf = std::max(std::max(KINT32_MIN + min_mis, KINT32_MIN + oe1),
-                                 KINT32_MIN + oe2) + 512 * std::max(e1, e2);
+    const int32_t TMIN = sizeof(T) == 2 ? KINT16_MIN : KINT32_MIN;
+    const int32_t inf = std::max(std::max(TMIN + min_mis, TMIN + oe1),
+                                 TMIN + oe2) + 512 * std::max(e1, e2);
 
     // subgraph reachability mask (abpoa_align_simd.c:1259-1269); persistent
     // workspace — per-alignment vector-of-vectors allocation dominated the
@@ -900,7 +985,7 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         return std::min(qlen, std::max(g.mpr[nid], r) + w);
     };
 
-    DpPlanes dp(g);
+    DpPlanes<T> dp(g);
     dp.inf = inf;
     dp.start(gn, n_planes);
 
@@ -933,22 +1018,25 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                 if (n_planes >= 5) dp.E2[p0 + j] = dp.F2[p0 + j] = 0;
             }
         } else if (linear) {
-            for (int j = 0; j <= e0; ++j) dp.H[p0 + j] = -e1 * j;
+            for (int j = 0; j <= e0; ++j)
+                dp.H[p0 + j] = clamp_store<T>(-e1 * j);
         } else if (gap_mode == 1) {
-            dp.H[p0] = 0; dp.E1[p0] = -oe1; dp.F1[p0] = inf;
+            dp.H[p0] = 0; dp.E1[p0] = clamp_store<T>(-oe1);
+            dp.F1[p0] = clamp_store<T>(inf);
             for (int j = 1; j <= e0; ++j) {
-                dp.F1[p0 + j] = -o1 - e1 * j;
+                dp.F1[p0 + j] = clamp_store<T>(-o1 - e1 * j);
                 dp.H[p0 + j] = dp.F1[p0 + j];
-                dp.E1[p0 + j] = inf;
+                dp.E1[p0 + j] = clamp_store<T>(inf);
             }
         } else {
-            dp.H[p0] = 0; dp.E1[p0] = -oe1; dp.E2[p0] = -oe2;
-            dp.F1[p0] = dp.F2[p0] = inf;
+            dp.H[p0] = 0; dp.E1[p0] = clamp_store<T>(-oe1);
+            dp.E2[p0] = clamp_store<T>(-oe2);
+            dp.F1[p0] = dp.F2[p0] = clamp_store<T>(inf);
             for (int j = 1; j <= e0; ++j) {
-                dp.F1[p0 + j] = -o1 - e1 * j;
-                dp.F2[p0 + j] = -o2 - e2 * j;
+                dp.F1[p0 + j] = clamp_store<T>(-o1 - e1 * j);
+                dp.F2[p0 + j] = clamp_store<T>(-o2 - e2 * j);
                 dp.H[p0 + j] = std::max(dp.F1[p0 + j], dp.F2[p0 + j]);
-                dp.E1[p0 + j] = dp.E2[p0 + j] = inf;
+                dp.E1[p0 + j] = dp.E2[p0 + j] = clamp_store<T>(inf);
             }
         }
     }
@@ -1006,44 +1094,44 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             // M from pred H at j-1: overlap of [b,e] with [pb+1, pe+1]
             {
                 const int lo = std::max(b, pb + 1), hi = std::min(e, pe + 1);
-                const int32_t* Hp = dp.H.data() + pp - pb;  // Hp[j-1] valid
+                const T* Hp = dp.H.data() + pp - pb;  // Hp[j-1] valid
                 int32_t* Mqp = Mq.data() - b;
                 if (ps == 0) {
                     for (int j = lo; j <= hi; ++j)
-                        Mqp[j] = std::max(Mqp[j], Hp[j - 1]);
+                        Mqp[j] = std::max(Mqp[j], (int32_t)Hp[j - 1]);
                 } else {
                     for (int j = lo; j <= hi; ++j)
-                        Mqp[j] = std::max(Mqp[j], Hp[j - 1] + ps);
+                        Mqp[j] = std::max(Mqp[j], (int32_t)Hp[j - 1] + ps);
                 }
             }
             // E from pred at j: overlap of [b,e] with [pb, pe]
             {
                 const int lo = std::max(b, pb), hi = std::min(e, pe);
                 if (linear) {
-                    const int32_t* Hp = dp.H.data() + pp - pb;
+                    const T* Hp = dp.H.data() + pp - pb;
                     int32_t* Ep = E1r.data() - b;
                     const int32_t d = e1 - ps;
                     for (int j = lo; j <= hi; ++j)
-                        Ep[j] = std::max(Ep[j], Hp[j] - d);
+                        Ep[j] = std::max(Ep[j], (int32_t)Hp[j] - d);
                 } else {
-                    const int32_t* E1p = dp.E1.data() + pp - pb;
+                    const T* E1p = dp.E1.data() + pp - pb;
                     int32_t* Ep = E1r.data() - b;
                     if (ps == 0) {
                         for (int j = lo; j <= hi; ++j)
-                            Ep[j] = std::max(Ep[j], E1p[j]);
+                            Ep[j] = std::max(Ep[j], (int32_t)E1p[j]);
                     } else {
                         for (int j = lo; j <= hi; ++j)
-                            Ep[j] = std::max(Ep[j], E1p[j] + ps);
+                            Ep[j] = std::max(Ep[j], (int32_t)E1p[j] + ps);
                     }
                     if (convex) {
-                        const int32_t* E2p = dp.E2.data() + pp - pb;
+                        const T* E2p = dp.E2.data() + pp - pb;
                         int32_t* E2o = E2r.data() - b;
                         if (ps == 0) {
                             for (int j = lo; j <= hi; ++j)
-                                E2o[j] = std::max(E2o[j], E2p[j]);
+                                E2o[j] = std::max(E2o[j], (int32_t)E2p[j]);
                         } else {
                             for (int j = lo; j <= hi; ++j)
-                                E2o[j] = std::max(E2o[j], E2p[j] + ps);
+                                E2o[j] = std::max(E2o[j], (int32_t)E2p[j] + ps);
                         }
                     }
                 }
@@ -1080,11 +1168,11 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         if (linear) {
             // in-row chain on H plane: H[j] = max(H[j], H[j-1]-e1)
             int32_t prev = Hh[0];
-            dp.H[pi] = local ? std::max(prev, 0) : prev;
+            dp.H[pi] = clamp_store<T>(local ? std::max(prev, 0) : prev);
             for (int j = 1; j < width; ++j) {
                 int32_t v = std::max(Hh[j], prev - e1);
                 prev = v;
-                dp.H[pi + j] = local ? std::max(v, 0) : v;
+                dp.H[pi + j] = clamp_store<T>(local ? std::max(v, 0) : v);
             }
         } else {
             // F chains: F[b]=Mq[b]-oe; F[j]=max(Hh[j-1]-oe, F[j-1]-e).
@@ -1092,23 +1180,24 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
             // vectorized form was measured SLOWER at typical ~220-cell
             // bands), so keep ONLY the carry sequential and finalize
             // H/E elementwise in a separate autovectorized pass.
-            int32_t* F1row = dp.F1.data() + pi;
-            int32_t* E1row = dp.E1.data() + pi;
-            int32_t* Hrow = dp.H.data() + pi;
+            T* F1row = dp.F1.data() + pi;
+            T* E1row = dp.E1.data() + pi;
+            T* Hrow = dp.H.data() + pi;
             if (convex) {
-                int32_t* F2row = dp.F2.data() + pi;
-                int32_t* E2row = dp.E2.data() + pi;
+                T* F2row = dp.F2.data() + pi;
+                T* E2row = dp.E2.data() + pi;
                 int32_t f1 = Mq[0] - oe1, f2 = Mq[0] - oe2;
-                F1row[0] = f1;
-                F2row[0] = f2;
+                F1row[0] = clamp_store<T>(f1);
+                F2row[0] = clamp_store<T>(f2);
                 for (int j = 1; j < width; ++j) {
                     f1 = std::max(Hh[j - 1] - oe1, f1 - e1);
                     f2 = std::max(Hh[j - 1] - oe2, f2 - e2);
-                    F1row[j] = f1;
-                    F2row[j] = f2;
+                    F1row[j] = clamp_store<T>(f1);
+                    F2row[j] = clamp_store<T>(f2);
                 }
                 for (int j = 0; j < width; ++j) {
-                    int32_t hrow = std::max(std::max(Hh[j], F1row[j]), F2row[j]);
+                    int32_t hrow = std::max(std::max(Hh[j], (int32_t)F1row[j]),
+                                            (int32_t)F2row[j]);
                     if (local) hrow = std::max(hrow, 0);
                     int32_t e1n = std::max((int32_t)(E1r[j] - e1), hrow - oe1);
                     int32_t e2n = std::max((int32_t)(E2r[j] - e2), hrow - oe2);
@@ -1116,27 +1205,27 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
                         e1n = std::max(e1n, 0);
                         e2n = std::max(e2n, 0);
                     }
-                    Hrow[j] = hrow;
-                    E1row[j] = e1n;
-                    E2row[j] = e2n;
+                    Hrow[j] = clamp_store<T>(hrow);
+                    E1row[j] = clamp_store<T>(e1n);
+                    E2row[j] = clamp_store<T>(e2n);
                 }
             } else {
                 int32_t f1 = Mq[0] - oe1;
-                F1row[0] = f1;
+                F1row[0] = clamp_store<T>(f1);
                 for (int j = 1; j < width; ++j) {
                     f1 = std::max(Hh[j - 1] - oe1, f1 - e1);
-                    F1row[j] = f1;
+                    F1row[j] = clamp_store<T>(f1);
                 }
                 const int32_t dead = local ? 0 : inf;
                 for (int j = 0; j < width; ++j) {
-                    int32_t hrow = std::max(Hh[j], F1row[j]);
+                    int32_t hrow = std::max(Hh[j], (int32_t)F1row[j]);
                     if (local) hrow = std::max(hrow, 0);
                     // affine E kill when F strictly dominates H
                     // (abpoa_align_simd.c:926-930)
                     int32_t e1n = (hrow == Hh[j])
                         ? std::max((int32_t)(E1r[j] - e1), hrow - oe1) : dead;
-                    Hrow[j] = hrow;
-                    E1row[j] = e1n;
+                    Hrow[j] = clamp_store<T>(hrow);
+                    E1row[j] = clamp_store<T>(e1n);
                 }
             }
         }
@@ -1144,16 +1233,16 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
         // ---- row max: local/extend scoring + adaptive band ----------------
         if (local || extend || banded) {
             // vectorizable max reduction, then first/last-equal scans
-            const int32_t* Hp = dp.H.data() + pi;
+            const T* Hp = dp.H.data() + pi;
             int32_t mx = inf;
-            for (int j = 0; j < width; ++j) mx = std::max(mx, Hp[j]);
+            for (int j = 0; j < width; ++j) mx = std::max(mx, (int32_t)Hp[j]);
             int left = -1, right = -1;
             if (mx > inf) {
                 int j = 0;
-                while (Hp[j] != mx) ++j;
+                while ((int32_t)Hp[j] != mx) ++j;
                 left = b + j;
                 j = width - 1;
-                while (Hp[j] != mx) --j;
+                while ((int32_t)Hp[j] != mx) --j;
                 right = b + j;
             }
             if (local) {
@@ -1329,5 +1418,3 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     meta[7] = cig.n;
     return 0;
 }
-
-}  // extern "C"

@@ -120,3 +120,47 @@ def test_native_inc_path_score_matches_oracle(extra, tmp_path):
     assert nat, "native graph not engaged for -G"
     assert out_np == out_nat
     assert calls["n"] == 0, "native path silently fell back to the oracle"
+
+
+def test_native_int16_plane_parity(tmp_path):
+    """int16 plane STORAGE (selected by the reference's score-width bound,
+    abpoa_align_simd.c:1284-1302) must be byte-identical to forced-int32
+    planes across modes, gap regimes, and outputs — the saturating store
+    keeps decayed -inf cells below every real score."""
+    import io
+    import subprocess
+    import sys
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    sim = str(tmp_path / "i16.fa")
+    subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "make_sim.py"),
+         "--ref-len", "800", "--n-reads", "12", "--err", "0.12",
+         "--seed", "77", "--out", sim], check=True)
+
+    def run(path, flags, force32):
+        env_key = "ABPOA_TPU_NATIVE_I32"
+        if force32:
+            os.environ[env_key] = "1"
+        else:
+            os.environ.pop(env_key, None)
+        try:
+            abpt = Params()
+            abpt.device = "native"
+            for k, v in flags.items():
+                setattr(abpt, k, v)
+            abpt.finalize()
+            out = io.StringIO()
+            msa_from_file(Abpoa(), abpt, path, out)
+            return out.getvalue()
+        finally:
+            os.environ.pop(env_key, None)
+
+    cases = [{}, {"gap_open2": 0}, {"gap_open1": 0, "gap_open2": 0},
+             {"align_mode": 1}, {"align_mode": 2, "zdrop": 20},
+             {"out_msa": True, "out_cons": False}]
+    for path in (os.path.join(DATA_DIR, "seq.fa"), sim):
+        for flags in cases:
+            assert run(path, flags, False) == run(path, flags, True), \
+                (path, flags)
