@@ -49,8 +49,10 @@ register_backend("numpy", align_sequence_to_subgraph_numpy)
 
 
 def _resolve(abpt: Params) -> Callable:
+    from ..obs import count
     name = abpt.device
     if name in _BACKENDS:
+        count(f"dispatch.{name}")
         return _BACKENDS[name]
     if name in ("jax", "tpu", "pallas", "native"):
         if name == "native":
@@ -67,11 +69,13 @@ def _resolve(abpt: Params) -> Callable:
                 warn_unreachable_once(
                     "Warning: JAX backend probe timed out (wedged "
                     "accelerator tunnel?); using the host kernel.")
+                count("fallback.jax_probe_timeout")
                 try:
                     from . import native_backend  # registers "native"
                     name = "native"
                 except Exception:
                     name = "numpy"
+                count(f"dispatch.{name}")
                 return _BACKENDS[name]
             apply_platform_pin()
             from . import jax_backend  # lazy: registers "jax"
@@ -80,6 +84,7 @@ def _resolve(abpt: Params) -> Callable:
             if name == "tpu":
                 name = "jax"
         if name in _BACKENDS:
+            count(f"dispatch.{name}")
             return _BACKENDS[name]
     raise ValueError(f"Unknown DP backend: {abpt.device}")
 

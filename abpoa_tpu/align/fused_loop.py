@@ -798,7 +798,9 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     nb = jnp.zeros(T + 1, jnp.int32).at[tgt].set(jnp.where(is_path, b, 0))
     r_ = jnp.arange(T + 1, dtype=jnp.int32)
     is_old_path = (r_ < L) & (path_new == 0)
-    last_old = jnp.maximum.accumulate(jnp.where(is_old_path, r_, -1))
+    # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate methods
+    # are absent on jax 0.4.x (jnp.maximum is a plain PjitFunction there)
+    last_old = lax.cummax(jnp.where(is_old_path, r_, -1))
     span_src = jnp.where(last_old >= 0, path_nodes[jnp.clip(last_old, 0, T)],
                          C.SRC_NODE_ID)
     n_span_val = g.n_span[span_src]
@@ -908,7 +910,7 @@ def _splice_order(order, n2i, old_n, new_n, path_nodes, path_len, path_new):
     is_old = on_path & (path_new == 0)
 
     # old position of nearest old path node before each rank (SRC for none)
-    last_old_rank = jnp.maximum.accumulate(jnp.where(is_old, r, -1))
+    last_old_rank = lax.cummax(jnp.where(is_old, r, -1))
     anchor_node = jnp.where(last_old_rank >= 0,
                             path_nodes[jnp.clip(last_old_rank, 0, T1 - 1)],
                             C.SRC_NODE_ID)
@@ -930,8 +932,7 @@ def _splice_order(order, n2i, old_n, new_n, path_nodes, path_len, path_new):
     # rank of a new node within its gap = running count among new ranks since
     # the last old path node
     cum_new = jnp.cumsum(is_new.astype(jnp.int32))
-    within = cum_new - 1 - jnp.maximum.accumulate(
-        jnp.where(is_old, cum_new, 0))
+    within = cum_new - 1 - lax.cummax(jnp.where(is_old, cum_new, 0))
     # position of a new node = anchor's shifted position + 1 + within-gap rank
     shift_before = jnp.where(anchor_pos > 0,
                              shift[jnp.clip(anchor_pos - 1, 0, N - 1)], 0)
@@ -1564,21 +1565,43 @@ def _grown_caps(errs, N: int, E: int, A: int, W: int, plane16: bool):
     Returns (N, E, A, W, plane16, grew) where `grew` means the device state
     needs _grow_state (pure padding); W/plane16 changes need only an err
     reset (the next chunk recompiles with the new statics)."""
+    from ..obs import count
     grew = False
     if any(e in (ERR_NODE_CAP, ERR_OPS_CAP, ERR_GRAPH_CAP) for e in errs):
         N = _bucket(int(N * 1.7), 1024)
         grew = True
+        count("fused.grow.node")
     if any(e in (ERR_EDGE_CAP, ERR_GRAPH_CAP) for e in errs):
         E *= 2
         grew = True
+        count("fused.grow.edge")
     if any(e in (ERR_ALIGN_CAP, ERR_GRAPH_CAP) for e in errs):
         A *= 2
         grew = True
+        count("fused.grow.aligned")
     if ERR_BAND_CAP in errs:
         W *= 2
+        count("fused.grow.band")
     if ERR_PROMOTE in errs:
         plane16 = False
+        count("fused.promote_int32")
     return N, E, A, W, plane16, grew
+
+
+def _record_fused_dp(abpt: Params, n_reads: int, qmax: int, n_final: int,
+                     W: int, Qp: int) -> None:
+    """Telemetry cell-total model for one finished fused run: reads 2..R
+    each sweep a graph whose row count ramps ~linearly from the first
+    read's chain (qmax+2) to the final node count, each row computing one
+    W-wide window (clipped to the padded query). Host-side arithmetic over
+    scalars the driver already downloaded — no extra device syncs."""
+    if n_reads <= 1:
+        return
+    from ..obs import report
+    band = min(W, Qp)
+    avg_rows = (qmax + 2 + n_final) / 2.0
+    cells = int((n_reads - 1) * avg_rows * band)
+    report().record_dp_cells(cells, n_reads - 1, band, abpt.gap_mode)
 
 
 def progressive_poa_fused(seqs: List[np.ndarray],
@@ -1649,43 +1672,59 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                                  n_rc=n_reads if amb else 1)
     if use_pallas:
         from .pallas_fused import fits_vmem, fits_vmem_local_hbm
+    from ..obs import count, device_capture
     kahn_total = 0
-    for _ in range(max_chunks):
-        max_ops = N + Qp + 8
-        inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
-        # static VMEM guard: local mode (and band growth) can push W past
-        # what the kernel's rings fit; local falls to the HBM-resident
-        # variant, everything else to the XLA scan
-        up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
-                                      m=abpt.m, Qp=Qp)
-        up_hbm = (use_pallas and not up and local_m
-                  and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
-                                          m=abpt.m, Qp=Qp))
-        state = run_fused_chunk(
-            state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
-            qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
-            **_static_chunk_kwargs(
-                abpt, W=W, max_ops=max_ops, plane16=plane16,
-                int16_limit=int16_limit, use_pallas=up,
-                pl_interpret=pl_interpret, record_paths=record_paths,
-                amb=amb, local_m=local_m, pallas_hbm=up_hbm))
-        err = int(state.err)
-        done = int(state.read_idx)
-        if err == ERR_OK and done >= n_reads:
-            break
-        if err == ERR_BACKTRACK:
-            raise RuntimeError(
-                f"fused loop: device backtrack failed at read {done}")
-        if err not in _RECOVERABLE_ERRS:
-            raise RuntimeError(f"fused loop: unknown error {err} at read {done}")
-        N, E, A, W, plane16, grew = _grown_caps((err,), N, E, A, W, plane16)
-        if grew:
-            state = _grow_state(state, N, E, A)
+    with device_capture("fused_loop"):
+        for chunk_i in range(max_chunks):
+            max_ops = N + Qp + 8
+            inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+            # static VMEM guard: local mode (and band growth) can push W past
+            # what the kernel's rings fit; local falls to the HBM-resident
+            # variant, everything else to the XLA scan
+            up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
+                                          m=abpt.m, Qp=Qp)
+            up_hbm = (use_pallas and not up and local_m
+                      and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
+                                              m=abpt.m, Qp=Qp))
+            count("fused.chunks")
+            if chunk_i > 0:
+                # every grow-and-resume re-entry changes a shape or a
+                # static -> XLA recompiles the chunk
+                count("fused.recompiles")
+            if use_pallas and not up and not up_hbm:
+                count("fallback.pallas_vmem")
+            count("fused.dispatch.pallas" if up else
+                  ("fused.dispatch.pallas_hbm" if up_hbm
+                   else "fused.dispatch.xla"))
+            state = run_fused_chunk(
+                state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
+                qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
+                **_static_chunk_kwargs(
+                    abpt, W=W, max_ops=max_ops, plane16=plane16,
+                    int16_limit=int16_limit, use_pallas=up,
+                    pl_interpret=pl_interpret, record_paths=record_paths,
+                    amb=amb, local_m=local_m, pallas_hbm=up_hbm))
+            err = int(state.err)
+            done = int(state.read_idx)
+            if err == ERR_OK and done >= n_reads:
+                break
+            if err == ERR_BACKTRACK:
+                raise RuntimeError(
+                    f"fused loop: device backtrack failed at read {done}")
+            if err not in _RECOVERABLE_ERRS:
+                raise RuntimeError(
+                    f"fused loop: unknown error {err} at read {done}")
+            N, E, A, W, plane16, grew = _grown_caps((err,), N, E, A, W,
+                                                    plane16)
+            if grew:
+                state = _grow_state(state, N, E, A)
+            else:
+                state = state._replace(err=jnp.int32(ERR_OK))
         else:
-            state = state._replace(err=jnp.int32(ERR_OK))
-    else:
-        raise RuntimeError("fused loop: capacity growth did not converge")
+            raise RuntimeError("fused loop: capacity growth did not converge")
     kahn_total = int(state.kahn_runs)
+    count("fused.kahn_resorts", kahn_total)
+    count("fused.collisions", int(state.collisions))
 
     if abpt.use_read_ids and int(state.collisions) > 0:
         # a sequential-fusion fallback may have taken a different path than
@@ -1694,6 +1733,11 @@ def progressive_poa_fused(seqs: List[np.ndarray],
         raise RuntimeError(
             f"fused loop: {int(state.collisions)} sequential-fusion "
             "fallbacks; read-id replay unavailable")
+
+    # only after the collision check: a raise above sends the caller to the
+    # per-read host loop, which records every read itself — recording here
+    # first would double-count the run's dp.cells
+    _record_fused_dp(abpt, n_reads, qmax, int(state.g.node_n), W, Qp)
 
     pg = _download_graph(state, abpt)
     if abpt.use_read_ids:
@@ -1831,50 +1875,68 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     # sets frozen by an unrecoverable per-set error; their err stays
     # non-OK so the vmapped while_loop skips them in later chunks
     failed = np.zeros(K, dtype=bool)
-    for _ in range(max_chunks):
-        max_ops = N + Qp + 8
-        inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
-        up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
-                                      m=abpt.m, Qp=Qp)
-        up_hbm = (use_pallas and not up and local_m
-                  and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
-                                          m=abpt.m, Qp=Qp))
+    from ..obs import count, device_capture, observe
+    observe("lockstep.k", K)
+    finished_prev = np.zeros(K, dtype=bool)
+    with device_capture("fused_lockstep_batch"):
+        for _ in range(max_chunks):
+            max_ops = N + Qp + 8
+            inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+            up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
+                                          m=abpt.m, Qp=Qp)
+            up_hbm = (use_pallas and not up and local_m
+                      and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
+                                              m=abpt.m, Qp=Qp))
+            count("lockstep.chunks")
+            # a chunk re-entered while some sets are already finished only
+            # drains the stragglers: finished sets no-op inside the vmapped
+            # while_loop but still occupy their batch slot
+            if finished_prev.any():
+                count("lockstep.drain_chunks")
+            observe("lockstep.noop_set_fraction",
+                    float(finished_prev.mean()))
 
-        kwargs = _static_chunk_kwargs(
-            abpt, W=W, max_ops=max_ops, plane16=plane16,
-            int16_limit=int16_limit, use_pallas=up,
-            pl_interpret=pl_interpret, record_paths=record_paths,
-            amb=amb, local_m=local_m, pallas_hbm=up_hbm)
+            kwargs = _static_chunk_kwargs(
+                abpt, W=W, max_ops=max_ops, plane16=plane16,
+                int16_limit=int16_limit, use_pallas=up,
+                pl_interpret=pl_interpret, record_paths=record_paths,
+                amb=amb, local_m=local_m, pallas_hbm=up_hbm)
 
-        def chunk_one(st, sq, wg, ln, nr, qp):
-            return run_fused_chunk(
-                st, sq, wg, ln, nr, qp, mat_d,
-                *_scalar_chunk_args(abpt, inf_min), **kwargs)
+            def chunk_one(st, sq, wg, ln, nr, qp):
+                return run_fused_chunk(
+                    st, sq, wg, ln, nr, qp, mat_d,
+                    *_scalar_chunk_args(abpt, inf_min), **kwargs)
 
-        state = jax.vmap(chunk_one)(state, seqs_d, wgts_d, lens_d,
-                                    nreads_d, qp_d)
-        errs = np.asarray(state.err)
-        done = np.asarray(state.read_idx)
-        failed |= ~np.isin(errs, (ERR_OK,) + _RECOVERABLE_ERRS)
-        if (failed | ((errs == ERR_OK) & (done >= n_reads_v))).all():
-            break
-        # collective growth: shared buckets mean one set's capacity need
-        # grows every set (pure padding — device state is preserved)
-        N, E, A, W, plane16, grew = _grown_caps(
-            set(errs[~failed].tolist()), N, E, A, W, plane16)
-        if grew:
-            state = jax.vmap(lambda s: _grow_state(s, N, E, A))(state)
-        # clear recoverable codes; re-freeze failed sets (_grow_state
-        # resets every err to OK)
-        new_err = np.where(failed, np.int32(ERR_BACKTRACK),
-                           np.where(np.isin(errs, _RECOVERABLE_ERRS),
-                                    np.int32(ERR_OK), errs))
-        state = state._replace(err=_shard(new_err.astype(np.int32)))
-    else:
-        raise RuntimeError(
-            "fused lockstep batch: capacity growth did not converge")
+            state = jax.vmap(chunk_one)(state, seqs_d, wgts_d, lens_d,
+                                        nreads_d, qp_d)
+            errs = np.asarray(state.err)
+            done = np.asarray(state.read_idx)
+            failed |= ~np.isin(errs, (ERR_OK,) + _RECOVERABLE_ERRS)
+            finished_prev = failed | ((errs == ERR_OK) & (done >= n_reads_v))
+            if finished_prev.all():
+                break
+            # collective growth: shared buckets mean one set's capacity need
+            # grows every set (pure padding — device state is preserved)
+            N, E, A, W, plane16, grew = _grown_caps(
+                set(errs[~failed].tolist()), N, E, A, W, plane16)
+            if grew:
+                state = jax.vmap(lambda s: _grow_state(s, N, E, A))(state)
+            # clear recoverable codes; re-freeze failed sets (_grow_state
+            # resets every err to OK)
+            new_err = np.where(failed, np.int32(ERR_BACKTRACK),
+                               np.where(np.isin(errs, _RECOVERABLE_ERRS),
+                                        np.int32(ERR_OK), errs))
+            state = state._replace(err=_shard(new_err.astype(np.int32)))
+        else:
+            raise RuntimeError(
+                "fused lockstep batch: capacity growth did not converge")
 
     host = jax.device_get(state)
+    node_ns = np.asarray(host.g.node_n)
+    for k in range(K):
+        if not failed[k]:
+            _record_fused_dp(abpt, int(n_reads_v[k]), qmax,
+                             int(node_ns[k]), W, Qp)
     out = []
     for k in range(K):
         if failed[k]:

@@ -561,9 +561,10 @@ def _dp_full_batch_sharded(arrays, scalars, inf_min, scores, zdrop,
     import numpy as _np
     mesh = Mesh(_np.array(jax.devices()[:n_dev]), ("w",))
     fn = functools.partial(_dp_full_batch, **statics)
-    sharded = jax.shard_map(fn, mesh=mesh,
-                            in_specs=(P("w"), P("w"), P(), P(), P()),
-                            out_specs=P("w"), check_vma=False)
+    from ..utils.jaxcompat import shard_map
+    sharded = shard_map(fn, mesh=mesh,
+                        in_specs=(P("w"), P("w"), P(), P(), P()),
+                        out_specs=P("w"))
     return sharded(arrays, scalars, inf_min, scores, zdrop)
 
 

@@ -20,7 +20,9 @@ from .result import AlignResult
 def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
                                       end_node_id: int, query: np.ndarray) -> AlignResult:
     if not getattr(g, "is_native", False):
+        from ..obs import count
         from .oracle import align_sequence_to_subgraph_numpy
+        count("fallback.native_to_numpy")
         return align_sequence_to_subgraph_numpy(g, abpt, beg_node_id, end_node_id, query)
 
     lib = g._lib

@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="DP backend: auto | numpy | native | jax | pallas "
                         "[auto: accelerator if reachable, else native C++, "
                         "else numpy]")
+    p.add_argument("--report", type=str, default=None, metavar="FILE",
+                   help="write a structured JSON run report (versioned "
+                        "schema: phase wall-times, dispatch/fallback/"
+                        "recompile counters, DP-cell totals, MFU estimate) "
+                        "to FILE ('-' for stdout; falls to stderr when "
+                        "stdout carries the consensus)")
+    p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
+                   help="capture a jax.profiler (XProf/TensorBoard) trace "
+                        "around device dispatches into DIR")
     return p
 
 
@@ -125,6 +134,10 @@ def main(argv=None) -> int:
         return 1
     abpt = args_to_params(args).finalize()
     from .utils import set_verbose, run_stats
+    from . import obs
+    obs.start_run()
+    if args.profile_dir:
+        obs.set_profile_dir(args.profile_dir)
     set_verbose(abpt.verbose)
     if abpt.verbose >= C.VERBOSE_INFO:
         print(f"[abpoa_tpu::main] CMD: {' '.join(argv or sys.argv)}", file=sys.stderr)
@@ -147,6 +160,13 @@ def main(argv=None) -> int:
         if out_fp is not sys.stdout:
             out_fp.close()
     print(f"[abpoa_tpu::main] {run_stats(t0, c0)}", file=sys.stderr)
+    if args.report:
+        if args.report == "-" and out_fp is sys.stdout:
+            # consensus already owns stdout; appending JSON would corrupt
+            # the FASTA stream, so the report goes to stderr instead
+            obs.write_report("-", fp=sys.stderr)
+        else:
+            obs.write_report(args.report)
     return 0
 
 

@@ -47,14 +47,17 @@ def phred_score(n_cov: int, n_seq: int) -> int:
 
 
 def phred_score_vec(n_cov: np.ndarray, n_seq: int) -> np.ndarray:
-    """Vectorized phred_score, bit-identical to the scalar version above:
-    np.power/np.log10 call the same libm pow/log10 math.pow/math.log10 do,
-    and int truncation matches int() on the (always positive) result."""
-    if (n_cov > n_seq).any():
+    """phred_score over a coverage vector, computed via the scalar path.
+
+    np.power/np.log10 are NOT guaranteed bit-identical to math.pow/
+    math.log10 on every libm build; a one-ULP divergence flips the +0.499
+    truncation and changes an emitted phred character (ADVICE r5 #2).
+    Consensus rows are short, so the scalar loop costs nothing."""
+    n_cov = np.asarray(n_cov)
+    if n_cov.size and (n_cov > n_seq).any():
         raise ValueError(f"unexpected n_cov/n_seq (max {n_cov.max()}/{n_seq})")
-    x = 13.8 * (1.25 * n_cov.astype(np.float64) / n_seq - 0.25)
-    p = 1.0 - 1.0 / (1.0 + np.power(NAT_E, -x))
-    return 33 + (-10.0 * np.log10(p) + 0.499).astype(np.int64)
+    return np.fromiter((phred_score(int(c), n_seq) for c in n_cov.ravel()),
+                       dtype=np.int64, count=n_cov.size)
 
 
 def _popcount(x: int) -> int:

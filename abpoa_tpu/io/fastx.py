@@ -59,4 +59,6 @@ def iter_fastx(path: str) -> Iterator[SeqRecord]:
 
 
 def read_fastx(path: str) -> List[SeqRecord]:
-    return list(iter_fastx(path))
+    from ..obs import phase
+    with phase("fastx_parse"):
+        return list(iter_fastx(path))

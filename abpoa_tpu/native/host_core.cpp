@@ -814,7 +814,12 @@ int apg_align(void* h, int beg_node_id, int end_node_id,
     // penalties then simply select int32)
     const int64_t limit = 32767 - min_mis - oe1 - oe2
         - 512 * (int64_t)std::max(e1, e2);
-    if (!force32 && bound <= limit)
+    // -G accumulates per-transition path scores (incre_path_score, up to
+    // -20 each) on top of the alignment score; the static bound above only
+    // models match/gap growth, so long -G alignments can sink past the
+    // int16 inf sentinel and wrap. Always take the int32 core under -G.
+    const bool inc_ps = params[14] != 0;
+    if (!force32 && !inc_ps && bound <= limit)
         return apg_align_core<int16_t>(h, beg_node_id, end_node_id, query,
                                        qlen, mat, params, cigar_out,
                                        cigar_cap, meta);
@@ -866,9 +871,12 @@ int apg_cons_hb(void* h, int32_t* ids_out, int32_t* base_out,
             max_out[cur] = max_id;
             break;
         } else {
-            int32_t max_w = INT32_MIN;
-            int max_id = -1;
-            for (size_t i = 0; i < node.out_ids.size(); ++i) {
+            // seed from the first edge, not an INT32_MIN sentinel: the
+            // sentinel path could tie (max_w == out_w) while max_id is
+            // still -1 and read score[-1] (UB)
+            int max_id = node.out_ids[0];
+            int32_t max_w = node.out_w[0];
+            for (size_t i = 1; i < node.out_ids.size(); ++i) {
                 const int out_id = node.out_ids[i];
                 const int32_t out_w = node.out_w[i];
                 if (max_w < out_w) {
@@ -884,8 +892,12 @@ int apg_cons_hb(void* h, int32_t* ids_out, int32_t* base_out,
         for (int in_id : node.in_ids)
             if (--out_deg[in_id] == 0) q[tail++] = in_id;
     }
+    // a graph whose source never reached the BFS (dead-end component) or
+    // whose source has no out edges has no src->sink chain: walking from
+    // max_out[src] == -1 would index max_out[-1] (UB)
+    if (max_out[src] < 0) return 0;
     int len = 0;
-    for (int cur = max_out[src]; cur != sink; cur = max_out[cur]) {
+    for (int cur = max_out[src]; cur != sink && cur >= 0; cur = max_out[cur]) {
         if (len >= cap) return -1;  // caller resizes and retries
         ids_out[len] = cur;
         base_out[len] = g.nodes[cur].base;

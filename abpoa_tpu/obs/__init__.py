@@ -1,0 +1,16 @@
+"""Run-telemetry subsystem: structured phase timers, counters, JSON run
+reports (versioned schema), an MFU model, and on-chip profiler capture
+hooks. See report.py for the schema, mfu.py for the model's assumptions,
+capture.py for the `--profile-dir` hooks; README "Run telemetry" and
+PERF.md document the consumer side (bench.py, chip_watcher)."""
+from .capture import device_capture, profile_dir, set_profile_dir
+from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
+                     finalize_report, observe, phase, record_dp, report,
+                     set_enabled, start_run, summary, write_report)
+
+__all__ = [
+    "SCHEMA", "SCHEMA_KEYS", "SCHEMA_VERSION", "RunReport",
+    "count", "observe", "phase", "record_dp", "report",
+    "start_run", "set_enabled", "finalize_report", "write_report", "summary",
+    "device_capture", "profile_dir", "set_profile_dir",
+]

@@ -1,0 +1,82 @@
+"""On-chip capture hooks: jax.profiler/XProf traces around device dispatches.
+
+`--profile-dir DIR` arms a process-global profile directory; the fused-loop
+drivers then bracket their dispatch region with `device_capture(label)`,
+which starts ONE `jax.profiler` trace for the outermost region (nested
+regions reuse it via TraceAnnotation) and stops it on exit. The resulting
+trace opens in XProf/TensorBoard and attributes per-step device time to the
+annotated regions — the artifact the first alive TPU window needs.
+
+Everything is a no-op when no profile dir is set (the default), when jax is
+missing, or when the profiler refuses to start — a failed capture must
+never take down an alignment run.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+_PROFILE_DIR: Optional[str] = None
+_ACTIVE = False  # a jax trace is running (jax allows only one at a time)
+
+
+def set_profile_dir(path: Optional[str]) -> None:
+    global _PROFILE_DIR
+    if path:
+        os.makedirs(path, exist_ok=True)
+    _PROFILE_DIR = path or None
+
+
+def profile_dir() -> Optional[str]:
+    return _PROFILE_DIR
+
+
+@contextlib.contextmanager
+def device_capture(label: str) -> Iterator[None]:
+    """Trace-capture bracket for a device dispatch region.
+
+    Outermost call starts/stops the jax.profiler trace into the armed
+    directory; inner calls (and all calls when unarmed) degrade to a plain
+    TraceAnnotation / no-op."""
+    global _ACTIVE
+    d = _PROFILE_DIR
+    if d is None:
+        yield
+        return
+    try:
+        import jax
+    except Exception:
+        yield
+        return
+    started = False
+    if not _ACTIVE:
+        try:
+            jax.profiler.start_trace(d)
+            started = True
+            _ACTIVE = True
+        except Exception:
+            started = False
+    # enter/exit the annotation defensively: a profiler hiccup must leave
+    # the workload running un-annotated, and the generator must yield
+    # exactly once on every path
+    ann = None
+    try:
+        ann = jax.profiler.TraceAnnotation(label)
+        ann.__enter__()
+    except Exception:
+        ann = None
+    try:
+        yield
+    finally:
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        if started:
+            _ACTIVE = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -1,0 +1,93 @@
+"""Cell-updates FLOPs/bytes model -> MFU estimate.
+
+The POA DP kernel is integer vector work; there is no hardware counter for
+"POA cells/s", so the model counts the arithmetic the recurrence performs
+per in-band cell and divides by the device's published peak. Assumptions
+(documented in PERF.md):
+
+- Ops per cell by gap regime (adds + max ops in the H/E/F recurrences,
+  including the band masking select): linear 8, affine 16, convex 26.
+  These match a hand count of _dp_banded's per-cell arithmetic; the
+  reference SIMD kernel does the same work per cell
+  (abpoa_align_simd.c:935-1074).
+- Peak ops/s uses the chip's published dense-matmul peak as the capability
+  proxy (the VPU's int path has no separately published number). MFU here
+  is therefore a LOWER-bound-flavored utilization estimate, comparable
+  across runs on the same chip generation — its job is trend attribution,
+  not an absolute roofline claim.
+- Cell totals are host-side models of work dispatched (graph rows x band
+  window), not device readbacks; the fused loop's total is an estimate
+  from its static buckets (see fused_loop.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import constants as C
+
+# integer ops per in-band DP cell (model, see module docstring)
+CELL_INT_OPS = {
+    C.LINEAR_GAP: 8,
+    C.AFFINE_GAP: 16,
+    C.CONVEX_GAP: 26,
+}
+
+# published dense peak ops/s per chip generation (substring-matched against
+# jax's device_kind, lowercase). bf16 MXU numbers — see module docstring.
+# libtpu spells the lite chips two ways across releases ("TPU v5 lite" /
+# "TPU v5e"); both spellings must hit, and the lite keys must be checked
+# before the bare-generation fallbacks.
+_PEAK_OPS = (
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 394e12),
+    ("v5e", 394e12),
+    ("v5litepod", 394e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_ops_for_kind(kind: str) -> Optional[float]:
+    k = (kind or "").lower()
+    for key, peak in _PEAK_OPS:
+        if key in k:
+            return peak
+    return None
+
+
+# phases whose wall time covers the DP dispatches the cell counters model
+_ALIGN_PHASES = ("align", "align_fused")
+
+
+def mfu_block(rep, device: Optional[dict]) -> Optional[dict]:
+    """The report's `mfu` section. Cell-updates/s is emitted on every
+    backend (the cross-paper throughput metric); the MFU ratio itself only
+    when a non-CPU device with a known peak ran the work."""
+    cells = rep.counters.get("dp.cells", 0)
+    if not cells:
+        return None
+    ops = rep.counters.get("dp.cell_ops", 0)
+    align_wall = sum(rep.phases[p][0] for p in _ALIGN_PHASES
+                     if p in rep.phases)
+    block = {
+        "dp_cells": cells,
+        "dp_cell_ops": ops,
+        "align_wall_s": round(align_wall, 6),
+        "cell_updates_per_sec": (round(cells / align_wall, 1)
+                                 if align_wall > 0 else None),
+        "model_ops_per_sec": (round(ops / align_wall, 1)
+                              if align_wall > 0 else None),
+        "peak_ops_per_sec": None,
+        "mfu": None,
+    }
+    if device and device.get("platform") not in (None, "cpu"):
+        peak = peak_ops_for_kind(device.get("kind", ""))
+        if peak and align_wall > 0:
+            block["peak_ops_per_sec"] = peak
+            # significant digits, not decimal places: real MFUs here can
+            # be far below 1e-8 and must not round to zero
+            block["mfu"] = float(f"{ops / align_wall / peak:.6g}")
+    return block

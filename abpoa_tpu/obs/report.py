@@ -1,0 +1,215 @@
+"""Structured run telemetry: the process-global `RunReport`.
+
+The stderr verbosity ladder (utils/logging.py) answers "what happened";
+this module answers "where did the time go" in machine-readable form —
+the per-phase / per-counter attribution accelerated-alignment papers
+report (SeGraM's per-stage cycle breakdowns, arXiv:2205.05883; AnySeq/GPU's
+cell-updates-per-second per kernel stage, arXiv:2205.07610). One global
+report per run, reset by `start_run()`, rendered by `finalize_report()`
+into a versioned JSON schema (SCHEMA/SCHEMA_VERSION below).
+
+Overhead contract: every hook is host-side aggregation of values the
+pipeline already holds (dict increments, two `perf_counter()` calls per
+phase enter/exit). Nothing here adds device syncs to the hot loop;
+tests/test_obs.py guards warm-run wall with reporting on vs off.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from typing import Dict, Iterator, Optional
+
+SCHEMA = "abpoa-tpu-run-report"
+SCHEMA_VERSION = 1
+
+# top-level keys of the rendered report, in schema order. Goldened by
+# tests/test_obs.py: adding a key is a SCHEMA_VERSION bump.
+SCHEMA_KEYS = ("schema", "schema_version", "created", "total_wall_s",
+               "phase_wall_sum_s", "phases", "counters", "values",
+               "device", "mfu")
+
+
+class RunReport:
+    """Phase timers + counters + value summaries for one run."""
+
+    __slots__ = ("enabled", "t_start", "phases", "counters", "values")
+
+    def __init__(self) -> None:
+        self.enabled = True
+        self.reset()
+
+    def reset(self) -> None:
+        self.t_start = time.perf_counter()
+        self.phases: Dict[str, list] = {}    # name -> [wall_s, calls]
+        self.counters: Dict[str, int] = {}   # name -> int
+        self.values: Dict[str, list] = {}    # name -> [count, sum, min, max]
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Accumulating wall-clock timer; re-entries add up. Phases are
+        non-overlapping by convention (pipeline.py) so their sum is a
+        partition of run wall time."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            rec = self.phases.get(name)
+            if rec is None:
+                self.phases[name] = [dt, 1]
+            else:
+                rec[0] += dt
+                rec[1] += 1
+
+    def count(self, name: str, n: int = 1) -> None:
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        """Value summary (count/sum/min/max) — a histogram's moments without
+        bucket bookkeeping in the hot path."""
+        if not self.enabled:
+            return
+        rec = self.values.get(name)
+        if rec is None:
+            self.values[name] = [1, value, value, value]
+        else:
+            rec[0] += 1
+            rec[1] += value
+            if value < rec[2]:
+                rec[2] = value
+            if value > rec[3]:
+                rec[3] = value
+
+    def record_dp(self, rows: int, band_cols: int, gap_mode: int) -> None:
+        """Account one DP dispatch: band extent and cell totals, so reads/s
+        can be normalized to cell-updates/s (the AnySeq/GPU metric). Values
+        come from host-side planning state (graph row count, band formula)
+        — never from a device readback."""
+        self.record_dp_cells(rows * band_cols, 1, band_cols, gap_mode)
+
+    def record_dp_cells(self, cells: int, dispatches: int, band_cols: int,
+                        gap_mode: int) -> None:
+        """Pre-aggregated DP accounting (the fused loop reports its whole
+        run at once from a host-side model). Single owner of the dp.*
+        counter schema."""
+        if not self.enabled:
+            return
+        from .mfu import CELL_INT_OPS
+        self.observe("dp.band_width", band_cols)
+        self.count("dp.dispatches", dispatches)
+        self.count("dp.cells", cells)
+        self.count("dp.cell_ops", cells * CELL_INT_OPS.get(gap_mode, 16))
+
+    # ----------------------------------------------------------- rendering
+    def as_dict(self) -> dict:
+        from .mfu import mfu_block
+        total = time.perf_counter() - self.t_start
+        phases = {k: {"wall_s": round(v[0], 6), "calls": v[1]}
+                  for k, v in sorted(self.phases.items())}
+        values = {k: {"count": v[0], "sum": v[1], "min": v[2], "max": v[3]}
+                  for k, v in sorted(self.values.items())}
+        dev = _device_info()
+        rep = {
+            "schema": SCHEMA,
+            "schema_version": SCHEMA_VERSION,
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "total_wall_s": round(total, 6),
+            "phase_wall_sum_s": round(sum(v[0] for v in self.phases.values()),
+                                      6),
+            "phases": phases,
+            "counters": dict(sorted(self.counters.items())),
+            "values": values,
+            "device": dev,
+            "mfu": mfu_block(self, dev),
+        }
+        return rep
+
+
+def _device_info() -> Optional[dict]:
+    """Accelerator identity, host-side only: queried exclusively when jax is
+    already imported (a device path ran), so a native/numpy run never pays a
+    jax import — and never risks a wedged-tunnel hang — for its report."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        d = jax.devices()[0]
+        return {"backend": "jax", "platform": str(d.platform),
+                "kind": str(getattr(d, "device_kind", "") or "")}
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# process-global registry                                                     #
+# --------------------------------------------------------------------------- #
+
+_REPORT = RunReport()
+
+
+def report() -> RunReport:
+    return _REPORT
+
+
+def start_run() -> None:
+    """Reset the global report; call at the top of each CLI/pyapi run."""
+    _REPORT.reset()
+
+
+def set_enabled(flag: bool) -> None:
+    """Telemetry kill switch (the overhead-guard test's control arm)."""
+    _REPORT.enabled = bool(flag)
+
+
+def phase(name: str):
+    return _REPORT.phase(name)
+
+
+def count(name: str, n: int = 1) -> None:
+    _REPORT.count(name, n)
+
+
+def observe(name: str, value: float) -> None:
+    _REPORT.observe(name, value)
+
+
+def record_dp(rows: int, band_cols: int, gap_mode: int) -> None:
+    _REPORT.record_dp(rows, band_cols, gap_mode)
+
+
+def finalize_report() -> dict:
+    """Render the global report to its versioned dict."""
+    return _REPORT.as_dict()
+
+
+def write_report(path: str, rep: Optional[dict] = None, fp=None) -> None:
+    """`--report FILE` sink ('-' = stdout, or `fp` when the caller needs
+    to keep stdout clean for sequence output)."""
+    if rep is None:
+        rep = finalize_report()
+    text = json.dumps(rep, indent=1, sort_keys=False)
+    if path == "-":
+        (fp or sys.stdout).write(text + "\n")
+    else:
+        with open(path, "w") as out:
+            out.write(text + "\n")
+
+
+def summary(rep: dict) -> dict:
+    """The compact embedding used by bench.py / microbench / chip_watcher:
+    per-phase walls plus the throughput-normalization numbers, small enough
+    to live inside a BENCH_* `extra` blob."""
+    mfu = rep.get("mfu") or {}
+    return {
+        "schema_version": rep["schema_version"],
+        "phases": {k: v["wall_s"] for k, v in rep["phases"].items()},
+        "dp_cells": rep["counters"].get("dp.cells", 0),
+        "cell_updates_per_sec": mfu.get("cell_updates_per_sec"),
+        "mfu": mfu.get("mfu"),
+    }

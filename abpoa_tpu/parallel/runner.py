@@ -58,6 +58,9 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
     import jax
     from ..align.fused_loop import (partition_by_length_bucket,
                                     progressive_poa_fused_batch)
+    from ..obs import count, observe
+    count("lockstep.groups")
+    observe("lockstep.group_size", len(group))
     results: dict = {}
     dev = devices[gi % len(devices)]
     outs = []
@@ -70,11 +73,14 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
         flat.extend(sub)
         try:
             with jax.default_device(dev):
-                outs.extend(progressive_poa_fused_batch(
-                    [e[1] for e in sub], [e[2] for e in sub], abpt))
+                from ..obs import phase
+                with phase("align_fused"):
+                    outs.extend(progressive_poa_fused_batch(
+                        [e[1] for e in sub], [e[2] for e in sub], abpt))
         except RuntimeError as e:
             print(f"Warning: fused lockstep batch failed ({e}); "
                   "falling back to sequential processing.", file=sys.stderr)
+            count("fallback.lockstep_to_sequential")
             outs.extend([None] * len(sub))
     for (idx, _seqs, _w, ab), res in zip(flat, outs):
         if res is None:
@@ -216,10 +222,12 @@ def shard_dp_batch(mesh_devices: int = None):
 
     specs = tuple(P("set") for _ in range(11))
 
+    from ..utils.jaxcompat import shard_map
+
     @jax.jit
     def step(*stacked):
-        fn = jax.shard_map(jax.vmap(one_set), mesh=mesh, in_specs=specs,
-                           out_specs=P("set"), check_vma=False)
+        fn = shard_map(jax.vmap(one_set), mesh=mesh, in_specs=specs,
+                       out_specs=P("set"))
         return fn(*stacked)
 
     return mesh, step

@@ -12,6 +12,7 @@ from typing import IO, List, Optional
 import numpy as np
 
 from . import constants as C
+from . import obs
 from .align import align_sequence_to_graph, AlignResult
 from .cons.consensus import ConsensusResult, generate_consensus
 from .cons.msa import generate_rc_msa
@@ -60,6 +61,16 @@ def _rc_encode(seq: np.ndarray) -> np.ndarray:
     return rc
 
 
+def _band_cols(abpt: Params, qlen: int) -> int:
+    """Telemetry band-extent model for one per-read dispatch: the adaptive
+    band's planned window (2w+1 columns, the reference's band formula),
+    clipped to the full query when banding is off or wider than the row."""
+    if abpt.wb < 0:
+        return qlen + 1
+    w = abpt.wb + int(abpt.wf * qlen)
+    return min(qlen + 1, 2 * w + 1)
+
+
 def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarray],
         exist_n_seq: int) -> None:
     """Plain progressive POA, input order (src/abpoa_align.c:313-353)."""
@@ -72,16 +83,22 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
         read_id = exist_n_seq + i
         res = AlignResult()
         if g.node_n > 2:
-            res = align_sequence_to_graph(g, abpt, qseq)
-            if abpt.amb_strand and res.best_score < min(qlen, g.node_n - 2) * abpt.max_mat * 0.3333:
-                rc_qseq = _rc_encode(qseq)
-                rc_weight = weight[::-1].copy()
-                rc_res = align_sequence_to_graph(g, abpt, rc_qseq)
-                if rc_res.best_score > res.best_score:
-                    res = rc_res
-                    qseq, weight = rc_qseq, rc_weight
-                    ab.is_rc[read_id] = True
-        g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
+            obs.record_dp(g.node_n, _band_cols(abpt, qlen), abpt.gap_mode)
+            with obs.phase("align"):
+                res = align_sequence_to_graph(g, abpt, qseq)
+                if abpt.amb_strand and res.best_score < min(qlen, g.node_n - 2) * abpt.max_mat * 0.3333:
+                    rc_qseq = _rc_encode(qseq)
+                    rc_weight = weight[::-1].copy()
+                    # the rc retry is a second full DP pass
+                    obs.record_dp(g.node_n, _band_cols(abpt, qlen),
+                                  abpt.gap_mode)
+                    rc_res = align_sequence_to_graph(g, abpt, rc_qseq)
+                    if rc_res.best_score > res.best_score:
+                        res = rc_res
+                        qseq, weight = rc_qseq, rc_weight
+                        ab.is_rc[read_id] = True
+        with obs.phase("fusion"):
+            g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
 
 
 def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
@@ -97,6 +114,7 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
         warn_unreachable_once(
             "Warning: JAX backend probe timed out (wedged accelerator "
             "tunnel?); falling back to the host engine.")
+        obs.count("fallback.jax_probe_timeout")
         return False
     apply_platform_pin()
     from .align.eligibility import fused_eligible
@@ -116,11 +134,13 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
         if g.node_n > 2:
             init_graph = g
     try:
-        pg, _, is_rc = progressive_poa_fused(seqs, weights, abpt,
-                                             init_graph=init_graph)
+        with obs.phase("align_fused"):
+            pg, _, is_rc = progressive_poa_fused(seqs, weights, abpt,
+                                                 init_graph=init_graph)
     except RuntimeError as e:
         print(f"Warning: fused device loop failed ({e}); "
               "falling back to the per-read loop.", file=sys.stderr)
+        obs.count("fallback.fused_to_host")
         return False
     ab.graph = pg
     if abpt.amb_strand:
@@ -219,6 +239,8 @@ def _reroute_device_ineligible(abpt: Params) -> Optional[str]:
               f"using the {host} host kernel for this configuration.",
               file=sys.stderr)
         _REROUTE_WARNED = True
+    obs.count("reroute.device_ineligible")
+    obs.count("reroute." + reason.replace(" ", "_"))
     orig, abpt.device = abpt.device, host
     return orig
 
@@ -235,15 +257,19 @@ def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
 
 
 def _msa_inner(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
-    if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
-        try:
-            from .native.graph import NativePOAGraph
-            ab.graph = NativePOAGraph()
-        except Exception:
-            pass
-    elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
-        ab.graph = POAGraph()
-    ab.reset()
+    # first call in a process pays the graph-engine setup (native .so
+    # stat/dlopen + ctypes signature registration) — attribute it, or a
+    # cold CLI run shows 20-30ms of unexplained wall
+    with obs.phase("backend_init"):
+        if _want_native(abpt) and not getattr(ab.graph, "is_native", False):
+            try:
+                from .native.graph import NativePOAGraph
+                ab.graph = NativePOAGraph()
+            except Exception:
+                pass
+        elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
+            ab.graph = POAGraph()
+        ab.reset()
     if abpt.incr_fn:
         from .io.restore import restore_graph
         restore_graph(ab, abpt)
@@ -270,11 +296,13 @@ def _native_cons_fast_path(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> bool:
     from .cons.consensus import native_consensus_hb, native_hb_eligible
     if not native_hb_eligible(g, abpt) or abpt.out_gfa or abpt.out_pog:
         return False
-    abc = native_consensus_hb(g, ab.n_seq)
+    with obs.phase("consensus"):
+        abc = native_consensus_hb(g, ab.n_seq)
     if abc.n_cons == 0:
         print("Warning: no consensus sequence generated.", file=sys.stderr)
     ab.cons = abc
-    output_fx_consensus(abc, abpt, out_fp)
+    with obs.phase("output"):
+        output_fx_consensus(abc, abpt, out_fp)
     return True
 
 
@@ -284,21 +312,26 @@ def output(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> None:
         return
     g = ab.graph
     if getattr(g, "is_native", False):
-        g = g.to_python(abpt)  # output-time consumers walk Python nodes
+        with obs.phase("graph_export"):
+            g = g.to_python(abpt)  # output-time consumers walk Python nodes
     if abpt.out_gfa:
-        generate_gfa(g, abpt, ab.names, ab.is_rc,
-                     lambda: generate_consensus(g, abpt, ab.n_seq), out_fp)
+        with obs.phase("output"):
+            generate_gfa(g, abpt, ab.names, ab.is_rc,
+                         lambda: generate_consensus(g, abpt, ab.n_seq), out_fp)
     else:
-        if abpt.out_msa:
-            ab.cons = generate_rc_msa(g, abpt, ab.n_seq)
-        elif abpt.out_cons:
-            ab.cons = generate_consensus(g, abpt, ab.n_seq)
-            if not g.is_called_cons:
-                print("Warning: no consensus sequence generated.", file=sys.stderr)
-        if abpt.out_msa:
-            output_rc_msa(ab.cons, abpt, ab.names, ab.is_rc, out_fp)
-        elif abpt.out_cons:
-            output_fx_consensus(ab.cons, abpt, out_fp)
+        with obs.phase("consensus"):
+            if abpt.out_msa:
+                ab.cons = generate_rc_msa(g, abpt, ab.n_seq)
+            elif abpt.out_cons:
+                ab.cons = generate_consensus(g, abpt, ab.n_seq)
+                if not g.is_called_cons:
+                    print("Warning: no consensus sequence generated.",
+                          file=sys.stderr)
+        with obs.phase("output"):
+            if abpt.out_msa:
+                output_rc_msa(ab.cons, abpt, ab.names, ab.is_rc, out_fp)
+            elif abpt.out_cons:
+                output_fx_consensus(ab.cons, abpt, out_fp)
     if abpt.out_pog:
         from .io.plot import dump_pog
         dump_pog(ab, abpt)

@@ -12,6 +12,7 @@ from typing import List
 import numpy as np
 
 from . import constants as C
+from . import obs
 from .align import align_sequence_to_graph
 from .cons.consensus import generate_consensus
 from .cons.msa import generate_rc_msa
@@ -81,6 +82,14 @@ class msa_aligner:
         abpt.device = device
         self.abpt = abpt
         self.ab = Abpoa()
+        self._last_report = None
+
+    @property
+    def last_report(self):
+        """Structured run-telemetry dict (obs schema, versioned) for the
+        most recent msa()/msa_batch()/msa_output() call; None before the
+        first call. See abpoa_tpu/obs/report.py for the schema."""
+        return self._last_report
 
     # ------------------------------------------------------------- internals
     def _add_sequences(self, seqs: List[str], qscores, exist_n: int, tot_n: int):
@@ -100,9 +109,15 @@ class msa_aligner:
                 weights = np.asarray(q, dtype=np.int64)
                 if (weights < 0).any():
                     raise ValueError("Qscores must be non-negative integers.")
-            res = align_sequence_to_graph(g, abpt, bseq)
-            g.add_alignment(abpt, bseq, weights, None, res.cigar,
-                            exist_n + read_i, tot_n, True)
+            if g.node_n > 2:
+                from .pipeline import _band_cols
+                obs.record_dp(g.node_n, _band_cols(abpt, len(bseq)),
+                              abpt.gap_mode)
+            with obs.phase("align"):
+                res = align_sequence_to_graph(g, abpt, bseq)
+            with obs.phase("fusion"):
+                g.add_alignment(abpt, bseq, weights, None, res.cigar,
+                                exist_n + read_i, tot_n, True)
             self.ab.append_read(seq=seq)
 
     def _collect(self, n_seq: int, ab: Abpoa = None) -> msa_result:
@@ -111,18 +126,19 @@ class msa_aligner:
             ab = self.ab
         g = ab.graph
         from .cons.consensus import native_consensus_hb, native_hb_eligible
-        if native_hb_eligible(g, abpt):
-            abc = native_consensus_hb(g, n_seq)
-        else:
-            if getattr(g, "is_native", False):
-                g = g.to_python(abpt)
-            if abpt.out_msa:
-                abc = generate_rc_msa(g, abpt, n_seq)
-            elif abpt.out_cons:
-                abc = generate_consensus(g, abpt, n_seq)
+        with obs.phase("consensus"):
+            if native_hb_eligible(g, abpt):
+                abc = native_consensus_hb(g, n_seq)
             else:
-                from .cons.consensus import ConsensusResult
-                abc = ConsensusResult(n_seq=n_seq)
+                if getattr(g, "is_native", False):
+                    g = g.to_python(abpt)
+                if abpt.out_msa:
+                    abc = generate_rc_msa(g, abpt, n_seq)
+                elif abpt.out_cons:
+                    abc = generate_consensus(g, abpt, n_seq)
+                else:
+                    from .cons.consensus import ConsensusResult
+                    abc = ConsensusResult(n_seq=n_seq)
         decode = abpt.code_to_char
         cons_seq = ["".join(chr(decode[b]) for b in row) for row in abc.cons_base]
         cons_qv = ["".join(chr(q) for q in row) for row in abc.cons_phred]
@@ -161,6 +177,11 @@ class msa_aligner:
     # ------------------------------------------------------------ public API
     def msa(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,
             out_pog="", incr_fn="", qscores=None) -> msa_result:
+        # nested call from msa_batch's sequential fallback keeps the
+        # batch-level report instead of starting its own
+        nested = getattr(self, "_in_batch", False)
+        if not nested:
+            obs.start_run()
         abpt = self.abpt
         abpt.out_pog = (out_pog if isinstance(out_pog, str) else out_pog.decode()) or None
         exist_n = self._prepare(seqs, out_cons, out_msa, max_n_cons, min_freq,
@@ -171,6 +192,8 @@ class msa_aligner:
         if abpt.out_pog:
             from .io.plot import dump_pog
             dump_pog(self.ab, abpt)
+        if not nested:
+            self._last_report = obs.finalize_report()
         return result
 
     def msa_batch(self, seq_sets, out_cons, out_msa, max_n_cons=1,
@@ -183,6 +206,17 @@ class msa_aligner:
         sequential `msa()` path; results are identical either way."""
         if qscores_sets is not None and len(qscores_sets) != len(seq_sets):
             raise ValueError("qscores_sets must contain one entry per set.")
+        obs.start_run()
+        self._in_batch = True
+        try:
+            return self._msa_batch_inner(seq_sets, out_cons, out_msa,
+                                         max_n_cons, min_freq, qscores_sets)
+        finally:
+            self._in_batch = False
+            self._last_report = obs.finalize_report()
+
+    def _msa_batch_inner(self, seq_sets, out_cons, out_msa, max_n_cons,
+                         min_freq, qscores_sets) -> List[msa_result]:
         abpt = self.abpt
         abpt.out_cons = bool(out_cons)
         abpt.out_msa = bool(out_msa)
@@ -249,8 +283,9 @@ class msa_aligner:
                     list(zip(lockstep, enc_sets, wgt_sets))):
                 order.extend(e[0] for e in sub)
                 try:
-                    outs.extend(progressive_poa_fused_batch(
-                        [e[1] for e in sub], [e[2] for e in sub], abpt))
+                    with obs.phase("align_fused"):
+                        outs.extend(progressive_poa_fused_batch(
+                            [e[1] for e in sub], [e[2] for e in sub], abpt))
                 except RuntimeError:
                     outs.extend([None] * len(sub))
             for k, res in zip(order, outs):
@@ -269,6 +304,7 @@ class msa_aligner:
 
     def msa_align(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,
                   incr_fn="", qscores=None) -> "msa_aligner":
+        obs.start_run()
         exist_n = self._prepare(seqs, out_cons, out_msa, max_n_cons, min_freq,
                                 incr_fn, qscores)
         tot_n = exist_n + len(seqs)
@@ -291,4 +327,6 @@ class msa_aligner:
         return self
 
     def msa_output(self) -> msa_result:
-        return self._collect(self.ab.n_seq)
+        result = self._collect(self.ab.n_seq)
+        self._last_report = obs.finalize_report()
+        return result

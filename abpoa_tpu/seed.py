@@ -472,27 +472,32 @@ def lis_chaining(anchors: List[int], min_w: int) -> List[int]:
 def build_guide_tree_partition(seqs: List[np.ndarray], abpt: Params
                                ) -> Tuple[List[int], List[int], List[int]]:
     """(abpoa_seed.c:717-756). Returns (read_id_map, par_anchors, par_c)."""
+    from .obs import phase
     n_seq = len(seqs)
     read_id_map = list(range(n_seq))
-    mm, mm_c = collect_mm(seqs, abpt)
+    with phase("seeding"):
+        mm, mm_c = collect_mm(seqs, abpt)
     if abpt.progressive_poa and n_seq > 2:
-        read_id_map = build_guide_tree(abpt, n_seq, mm)
+        with phase("guide_tree"):
+            read_id_map = build_guide_tree(abpt, n_seq, mm)
     par_anchors: List[int] = []
     par_c = [0] * n_seq
     if abpt.disable_seeding or n_seq < 2:
         return read_id_map, par_anchors, par_c
-    q_cache: dict = {}
-    t_sorted = sorted(mm[mm_c[read_id_map[0]]: mm_c[read_id_map[0] + 1]],
-                      key=lambda t: t[0])
-    for i in range(1, n_seq):
-        tid, qid = read_id_map[i - 1], read_id_map[i]
-        if i > 1:
-            t_sorted = q_cache.get(tid) or sorted(
-                mm[mm_c[tid]: mm_c[tid + 1]], key=lambda t: t[0])
-        anchors = collect_anchors(mm, mm_c, tid, qid, len(seqs[qid]), abpt.k,
-                                  t_sorted, q_cache)
-        dp_chaining(anchors, abpt, len(seqs[tid]), len(seqs[qid]), par_anchors)
-        par_c[i] = len(par_anchors)
+    with phase("seeding"):
+        q_cache: dict = {}
+        t_sorted = sorted(mm[mm_c[read_id_map[0]]: mm_c[read_id_map[0] + 1]],
+                          key=lambda t: t[0])
+        for i in range(1, n_seq):
+            tid, qid = read_id_map[i - 1], read_id_map[i]
+            if i > 1:
+                t_sorted = q_cache.get(tid) or sorted(
+                    mm[mm_c[tid]: mm_c[tid + 1]], key=lambda t: t[0])
+            anchors = collect_anchors(mm, mm_c, tid, qid, len(seqs[qid]),
+                                      abpt.k, t_sorted, q_cache)
+            dp_chaining(anchors, abpt, len(seqs[tid]), len(seqs[qid]),
+                        par_anchors)
+            par_c[i] = len(par_anchors)
     return read_id_map, par_anchors, par_c
 
 
@@ -557,15 +562,25 @@ def anchor_poa(ab, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
             specs.append((beg_id, C.SINK_NODE_ID, beg_qpos, qlen))
 
         from .align.dispatch import align_windows
-        results = align_windows(
-            g, abpt, [(b, e, qseq[lo:hi]) for b, e, lo, hi in specs])
+        from .obs import phase, record_dp
+        from .pipeline import _band_cols
+        for _b, _e, lo, hi in specs:
+            # row count of an anchored window subgraph is not known host-side;
+            # model it as the window's target span (~= query span) like the
+            # reference's banded window DP
+            record_dp((hi - lo) + 2, _band_cols(abpt, hi - lo), abpt.gap_mode)
+        with phase("align"):
+            results = align_windows(
+                g, abpt, [(b, e, qseq[lo:hi]) for b, e, lo, hi in specs])
         for wi, res in enumerate(results):
             whole_cigar.extend(res.cigar)
             if wi < len(kmer_runs):
                 for j, nid in enumerate(kmer_runs[wi]):
                     push_cigar(whole_cigar, C.CMATCH, 1, nid, j)
-        g.add_subgraph_alignment(abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, qseq, weight,
-                                 qpos_to_node_id, whole_cigar, read_id, tot_n_seq, True)
+        with phase("fusion"):
+            g.add_subgraph_alignment(abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, qseq,
+                                     weight, qpos_to_node_id, whole_cigar,
+                                     read_id, tot_n_seq, True)
         tpos_to_node_id, qpos_to_node_id = qpos_to_node_id, tpos_to_node_id
         last_read_id = read_id
 

@@ -1,0 +1,20 @@
+"""Version-bridging shims for jax APIs that moved between 0.4.x and 0.5+.
+
+The container fleet pins different jax versions; the kernels must run on
+all of them. Keep every cross-version alias here so call sites stay
+single-form (see also fused_loop.py's lax.cummax note: jnp ufunc methods
+like `.accumulate` do not exist on 0.4.x).
+"""
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """`jax.shard_map(..., check_vma=False)` on new jax; the experimental
+    module (check_rep=False spelling) on 0.4.x."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)

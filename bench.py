@@ -59,17 +59,36 @@ def _accelerator_reachable():
         return False
 
 
+_LAST_REPORT = None
+
+
 def _time_run(device, path, warm=False):
+    from abpoa_tpu import obs
     from abpoa_tpu.params import Params
     from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    global _LAST_REPORT
     abpt = Params()
     abpt.device = device
     abpt.finalize()
     if warm:
         msa_from_file(Abpoa(), abpt, path, io.StringIO())
+    obs.start_run()  # per-phase attribution for the timed run only
     t0 = time.time()
     msa_from_file(Abpoa(), abpt, path, io.StringIO())
-    return time.time() - t0
+    wall = time.time() - t0
+    _LAST_REPORT = obs.finalize_report()
+    return wall
+
+
+def last_report():
+    """Full obs-schema report of the most recent _time_run in this process
+    (chip_watcher's bench_code children read this)."""
+    return _LAST_REPORT
+
+
+def last_report_summary():
+    from abpoa_tpu import obs
+    return obs.summary(_LAST_REPORT) if _LAST_REPORT else None
 
 
 # wall-clock caps for accelerator runs: a slow/hung device path must not
@@ -91,14 +110,36 @@ def _child_line(cmd, prefix, timeout):
     raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
 
 
+def _timed_child(code, timeout, env=None):
+    """Run a timing child that prints 'WALL <s>' and 'REPORT <json>';
+    return (wall_s, report_summary_or_None) or raise with the stderr
+    tail. Shared by every subprocess bench row."""
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, env=env)
+    wall = rep = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("WALL "):
+            wall = float(line[len("WALL "):])
+        elif line.startswith("REPORT "):
+            try:
+                rep = json.loads(line[len("REPORT "):])
+            except ValueError:
+                rep = None
+    if wall is None:
+        raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
+    return wall, rep
+
+
 def _time_run_subprocess(device, path, warm, timeout):
-    """Time a run in a subprocess with a hard timeout (device paths only)."""
+    """Time a run in a subprocess with a hard timeout (device paths only).
+    Returns (wall_s, report_summary_or_None)."""
     code = (
-        "import sys; sys.path.insert(0, {here!r})\n"
+        "import sys, json; sys.path.insert(0, {here!r})\n"
         "import bench\n"
         "print('WALL', bench._time_run({device!r}, {path!r}, warm={warm}))\n"
+        "print('REPORT ' + json.dumps(bench.last_report_summary()))\n"
     ).format(here=HERE, device=device, path=path, warm=warm)
-    return float(_child_line([sys.executable, "-c", code], "WALL ", timeout))
+    return _timed_child(code, timeout)
 
 
 def _time_run_cpu_fused(path, timeout=900):
@@ -107,33 +148,32 @@ def _time_run_cpu_fused(path, timeout=900):
     no accelerator answers. Subprocess: the config-level CPU pin must land
     before any backend init, and the probe child reads JAX_PLATFORMS."""
     code = (
-        "import os, sys; sys.path.insert(0, {here!r})\n"
+        "import os, sys, json; sys.path.insert(0, {here!r})\n"
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "import bench\n"
         "print('WALL', bench._time_run('jax', {path!r}, warm=True))\n"
+        "print('REPORT ' + json.dumps(bench.last_report_summary()))\n"
     ).format(here=HERE, path=path)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=timeout, env=env)
-    for line in proc.stdout.splitlines():
-        if line.startswith("WALL "):
-            return float(line.split()[1])
-    raise RuntimeError(proc.stderr.strip()[-300:] or "no timing output")
+    return _timed_child(code, timeout, env=dict(os.environ, JAX_PLATFORMS="cpu"))
 
 
-def _run_workload(key, path, n_reads, devices, warm, per_backend, results):
+def _run_workload(key, path, n_reads, devices, warm, per_backend, results,
+                  phase_reports):
     for device in devices:
         try:
             if device in ("jax", "pallas"):
-                wall = _time_run_subprocess(device, path, warm,
-                                            _JAX_TIMEOUT.get(key, 900))
+                wall, rep = _time_run_subprocess(device, path, warm,
+                                                 _JAX_TIMEOUT.get(key, 900))
             else:
                 wall = _time_run(device, path, warm=warm)
+                rep = last_report_summary()
         except Exception as e:
             print(f"[bench] {device} {key} failed: {e}", file=sys.stderr)
             continue
         rps = n_reads / wall
         per_backend.setdefault(key, {})[device] = round(rps, 2)
+        if rep is not None:
+            phase_reports.setdefault(key, {})[device] = rep
         best = results.get(key)
         if best is None or rps > best[0]:
             results[key] = (rps, device)
@@ -160,16 +200,20 @@ def main():
 
     per_backend = {}
     results = {}
+    phase_reports = {}
     sim2k = workloads["sim2k"]
     _run_workload("sim2k", os.path.join(HERE, sim2k["file"]),
-                  sim2k["n_reads"], devices, True, per_backend, results)
+                  sim2k["n_reads"], devices, True, per_backend, results,
+                  phase_reports)
 
     # fused-loop CPU row: tracks the device-path code on every platform
     # (reported in extra only — it never competes for the headline device)
     try:
-        wall = _time_run_cpu_fused(os.path.join(HERE, sim2k["file"]))
+        wall, rep = _time_run_cpu_fused(os.path.join(HERE, sim2k["file"]))
         per_backend.setdefault("sim2k", {})["fused_cpu"] = round(
             sim2k["n_reads"] / wall, 2)
+        if rep is not None:
+            phase_reports.setdefault("sim2k", {})["fused_cpu"] = rep
     except Exception as e:
         print(f"[bench] fused_cpu sim2k failed: {e}", file=sys.stderr)
 
@@ -179,7 +223,7 @@ def main():
         sim10k["n_reads"])
     big_devices = [d for d in devices if d != "numpy"]
     _run_workload("sim10k_500", p10k, sim10k["n_reads"], big_devices, False,
-                  per_backend, results)
+                  per_backend, results, phase_reports)
 
     if "jax" in devices:
         # lockstep multi-set batching: the per-chip throughput lever for
@@ -205,6 +249,11 @@ def main():
     base2k = sim2k["n_reads"] / sim2k["avx2_wall_s"]
     rps10k, dev10k = results.get("sim10k_500", (0.0, "none"))
     rps2k, dev2k = results.get("sim2k", (0.0, "none"))
+    # per-phase breakdown of each workload's winning device (full
+    # per-device reports land on stderr above via per_backend debugging);
+    # same obs schema as the CLI's --report
+    phases = {key: phase_reports.get(key, {}).get(dev)
+              for key, dev in (("sim2k", dev2k), ("sim10k_500", dev10k))}
     print(json.dumps({
         "metric": f"reads/sec (500x10kb ONT consensus, device={dev10k})",
         "value": round(rps10k, 3),
@@ -215,6 +264,7 @@ def main():
             "sim2k_vs_baseline": round(rps2k / base2k, 4),
             "sim2k_device": dev2k,
             "per_backend": per_backend,
+            "phases": phases,
         },
     }))
 

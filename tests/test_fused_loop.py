@@ -90,6 +90,7 @@ def test_fused_int16_promotion_boundary(monkeypatch):
     assert got == want
 
 
+@pytest.mark.slow
 def test_fused_scale_long_reads(tmp_path):
     """Scale parity (VERDICT round-1 item 8): a 40-read x 4 kb ONT-like set
     drives the graph through multiple capacity-growth buckets (final ~12.5k

@@ -1,0 +1,198 @@
+"""Run-telemetry subsystem tests (ISSUE 5 tentpole).
+
+- schema golden: the report's top-level keys are stable and versioned
+- end-to-end sim2k: phases cover >=90% of wall, dispatch/band/cell
+  counters are nonzero, the CLI --report flag emits the same schema
+- lockstep `-l` run: lockstep group/chunk counters and the fused phase
+- overhead guard: warm sim2k wall with reporting on is within noise of off
+- MFU model: the estimate appears exactly when a known device kind ran
+"""
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from conftest import DATA_DIR
+
+SIM2K = os.path.join(DATA_DIR, "sim2k.fa")
+
+
+def _native_or_skip():
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+
+
+def test_report_schema_golden():
+    """Top-level schema is goldened: any key change is a SCHEMA_VERSION
+    bump (downstream consumers: bench.py, chip_watcher, BENCH_onchip)."""
+    from abpoa_tpu import obs
+    from abpoa_tpu.pyapi import msa_aligner
+    a = msa_aligner(device="numpy")
+    assert a.last_report is None
+    res = a.msa(["ACGTACGTAA", "ACGTACGTA", "ACGTTCGTAA"], True, False)
+    assert res.n_cons == 1
+    rep = a.last_report
+    assert tuple(rep.keys()) == obs.SCHEMA_KEYS
+    assert rep["schema"] == obs.SCHEMA
+    assert rep["schema_version"] == obs.SCHEMA_VERSION == 1
+    assert rep["counters"]["dispatch.numpy"] == 2
+    assert rep["counters"]["dp.cells"] > 0
+    assert {"align", "fusion", "consensus"} <= set(rep["phases"])
+    for ph in rep["phases"].values():
+        assert set(ph) == {"wall_s", "calls"}
+    assert rep["phase_wall_sum_s"] <= rep["total_wall_s"] + 1e-6
+    band = rep["values"]["dp.band_width"]
+    assert set(band) == {"count", "sum", "min", "max"} and band["max"] > 0
+    # summary() is the compact embedding bench/chip_watcher commit
+    s = obs.summary(rep)
+    assert set(s) == {"schema_version", "phases", "dp_cells",
+                      "cell_updates_per_sec", "mfu"}
+    assert s["dp_cells"] == rep["counters"]["dp.cells"]
+
+
+def test_cli_report_sim2k(tmp_path):
+    """Acceptance: `abpoa-tpu sim2k.fa --report r.json` emits a versioned
+    report whose phase wall-times sum to >=90% of total wall with nonzero
+    dispatch/band/cell counters."""
+    _native_or_skip()
+    from abpoa_tpu.cli import main
+    rpt = str(tmp_path / "r.json")
+    out = str(tmp_path / "cons.fa")
+    rc = main([SIM2K, "--device", "native", "-o", out, "--report", rpt])
+    assert rc == 0
+    with open(rpt) as fp:
+        rep = json.load(fp)
+    assert rep["schema_version"] == 1
+    assert rep["counters"]["dispatch.native"] > 0
+    assert rep["counters"]["dp.cells"] > 0
+    assert rep["values"]["dp.band_width"]["max"] > 0
+    assert rep["phase_wall_sum_s"] >= 0.9 * rep["total_wall_s"], rep
+    with open(out) as fp:
+        assert fp.read().startswith(">")
+
+
+def test_lockstep_report_counters():
+    """A `-l` lockstep run (CPU jax backend) reports batch K, chunk count,
+    finished-set no-op fraction, and the fused align phase."""
+    import jax  # noqa: F401  (virtual CPU mesh from conftest)
+    from abpoa_tpu import obs
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import run_batch
+    obs.start_run()
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.finalize()
+    out = io.StringIO()
+    run_batch([os.path.join(DATA_DIR, "test.fa"),
+               os.path.join(DATA_DIR, "test.fa")], abpt, out)
+    assert out.getvalue().count(">Consensus_sequence") == 2
+    rep = obs.finalize_report()
+    assert rep["counters"]["lockstep.groups"] == 1
+    assert rep["counters"]["lockstep.chunks"] >= 1
+    assert rep["values"]["lockstep.k"]["max"] == 2
+    assert "lockstep.noop_set_fraction" in rep["values"]
+    assert "align_fused" in rep["phases"]
+    assert rep["counters"]["dp.cells"] > 0
+
+
+def test_overhead_guard_sim2k():
+    """Reporting must be free: warm sim2k wall with telemetry enabled
+    stays within noise of disabled (counters are host-side dict updates,
+    never device syncs). Bound is deliberately loose — this guards against
+    an accidental hot-loop sync, not scheduler jitter."""
+    _native_or_skip()
+    from abpoa_tpu import obs
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    def run_once():
+        abpt = Params()
+        abpt.device = "native"
+        abpt.finalize()
+        t0 = time.perf_counter()
+        msa_from_file(Abpoa(), abpt, SIM2K, io.StringIO())
+        return time.perf_counter() - t0
+
+    run_once()  # warm: .so load, file cache
+    try:
+        obs.set_enabled(True)
+        on = min(run_once() for _ in range(2))
+        obs.set_enabled(False)
+        off = min(run_once() for _ in range(2))
+    finally:
+        obs.set_enabled(True)
+    assert on <= off * 1.25 + 0.05, (on, off)
+
+
+def test_disabled_report_is_empty():
+    from abpoa_tpu import obs
+    try:
+        obs.start_run()
+        obs.set_enabled(False)
+        with obs.phase("align"):
+            pass
+        obs.count("dispatch.numpy")
+        obs.observe("dp.band_width", 3)
+        obs.record_dp(10, 10, 2)
+    finally:
+        obs.set_enabled(True)
+    rep = obs.finalize_report()
+    assert rep["phases"] == {} and rep["counters"] == {}
+    assert rep["values"] == {} and rep["mfu"] is None
+
+
+def test_mfu_model():
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.obs.mfu import (CELL_INT_OPS, mfu_block,
+                                   peak_ops_for_kind)
+    from abpoa_tpu.obs.report import RunReport
+    assert peak_ops_for_kind("TPU v4") == 275e12
+    # both libtpu spellings of the lite chips resolve
+    assert peak_ops_for_kind("TPU v5 lite") == 394e12
+    assert peak_ops_for_kind("TPU v5e") == 394e12
+    assert peak_ops_for_kind("TPU v6 lite") == 918e12
+    assert peak_ops_for_kind("TPU v5p") == 459e12
+    assert peak_ops_for_kind("TPU v9x") is None  # unknown stays None
+    rep = RunReport()
+    rep.phases["align_fused"] = [2.0, 1]
+    rep.counters["dp.cells"] = 10_000_000
+    rep.counters["dp.cell_ops"] = 10_000_000 * CELL_INT_OPS[C.CONVEX_GAP]
+    # CPU device: throughput yes, MFU no
+    blk = mfu_block(rep, {"platform": "cpu", "kind": ""})
+    assert blk["cell_updates_per_sec"] == 5_000_000
+    assert blk["mfu"] is None
+    # known TPU kind: MFU appears
+    blk = mfu_block(rep, {"platform": "tpu", "kind": "TPU v4"})
+    assert blk["peak_ops_per_sec"] == 275e12
+    assert blk["mfu"] == pytest.approx(
+        10_000_000 * CELL_INT_OPS[C.CONVEX_GAP] / 2.0 / 275e12, rel=1e-4)
+    # no cells recorded -> no block at all
+    assert mfu_block(RunReport(), None) is None
+
+
+def test_phred_vec_used_by_native_cons_matches_python():
+    """The native fast path's phred column must match the Python consensus
+    path byte for byte (it now shares the scalar phred)."""
+    _native_or_skip()
+    from abpoa_tpu.cons.consensus import phred_score, phred_score_vec
+    cov = np.array([0, 1, 5, 17, 20], dtype=np.int64)
+    assert phred_score_vec(cov, 20).tolist() == [
+        phred_score(int(c), 20) for c in cov]
+
+
+def test_device_capture_noop_without_dir(tmp_path):
+    """Capture hooks never interfere when unarmed, and arm/disarm works."""
+    from abpoa_tpu import obs
+    with obs.device_capture("x"):
+        pass  # unarmed: pure no-op
+    d = str(tmp_path / "prof")
+    obs.set_profile_dir(d)
+    try:
+        assert obs.profile_dir() == d and os.path.isdir(d)
+    finally:
+        obs.set_profile_dir(None)
+    assert obs.profile_dir() is None

@@ -40,6 +40,7 @@ def test_pallas_consensus_golden():
     assert got == golden("ref_consensus.txt")
 
 
+@pytest.mark.slow
 def test_pallas_heter_2cons():
     got = run_cli([os.path.join(DATA_DIR, "heter.fa"), "-d2",
                    "--device", "pallas"])

@@ -92,7 +92,10 @@ def _parity_subproc(fname, gap_kw, force_int32):
         f"child rc={proc.returncode}\n{proc.stderr[-2000:]}")
 
 
-@pytest.mark.parametrize("fname", ["test.fa", "seq.fa", "heter.fa"])
+@pytest.mark.parametrize("fname", [
+    "test.fa", "seq.fa",
+    pytest.param("heter.fa", marks=pytest.mark.slow),
+])
 def test_pallas_fused_matches_scan_int32(fname):
     """int32 planes (post-promotion regime), convex gap."""
     _parity_subproc(fname, {}, True)
@@ -233,6 +236,7 @@ print('PARITY-OK')
 """
 
 
+@pytest.mark.slow
 def test_pallas_fused_local_hbm_matches_scan():
     """Local mode at a width past the VMEM ring budget routes to the
     HBM-resident kernel (pallas_fused_dp_local_hbm) and byte-matches the

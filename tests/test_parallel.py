@@ -42,11 +42,13 @@ def test_shard_dp_batch_8way():
     assert out.shape[0] == 8
 
 
+@pytest.mark.slow
 def test_graft_dryrun():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_lockstep_growth_and_parity(tmp_path):
     """Lockstep multi-set batching with forced capacity growth: undersized
     starting buckets make every set trip ERR_NODE_CAP, the host grows the
@@ -150,6 +152,7 @@ def test_run_batch_mixed_eligibility(tmp_path):
     assert out.getvalue() == want.getvalue()
 
 
+@pytest.mark.slow
 def test_run_batch_8_sets_matches_sequential(tmp_path):
     """-l batch mode over the 8-device mesh: 8 distinct read sets, each
     device-processed set byte-matches the host-sequential result (the

@@ -1,0 +1,115 @@
+"""Round-6 satellite fixes (ISSUE 5 / ADVICE r5 #1-#3).
+
+1. Native int16 plane-width selection must yield to -G (inc_path_score):
+   path-score accumulation is unbounded by the static score bound, so -G
+   always takes the int32 core.
+2. phred_score_vec must equal the scalar phred_score over the full
+   coverage range (ULP divergence between numpy's and libm's pow/log10
+   could flip the +0.499 truncation).
+3. apg_cons_hb must not walk a dead-end graph into UB (max_out[src] == -1)
+   and must seed the per-node argmax from the first edge.
+"""
+import numpy as np
+import pytest
+
+from abpoa_tpu import constants as C
+from abpoa_tpu.params import Params
+
+
+def _native_or_skip():
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+    from abpoa_tpu.native.graph import NativePOAGraph
+    return NativePOAGraph
+
+
+def _chain_with_decoy(NativePOAGraph, L, heavy):
+    """A chain src->c0->...->c(L-1)->sink (edge w=1) where every chain node
+    also feeds a shared DEAD-END decoy with weight `heavy`: each chain
+    transition's -G path score is round(log(1/(heavy+1))) = -20 (the
+    clamp), and no alternative route to the sink exists, so the optimal
+    global alignment of the chain's own sequence scores
+    L*match - 20*(L-1)."""
+    g = NativePOAGraph()
+    ids = [g.add_node(0) for _ in range(L)]
+    dec = g.add_node(0)
+    g.add_edge(C.SRC_NODE_ID, ids[0], True, 1, False, False, 0, 0)
+    for i in range(L - 1):
+        g.add_edge(ids[i], ids[i + 1], True, 1, False, False, 0, 0)
+        g.add_edge(ids[i], dec, True, heavy, False, False, 0, 0)
+    g.add_edge(ids[-1], C.SINK_NODE_ID, True, 1, False, False, 0, 0)
+    return g
+
+
+def test_native_g_mode_takes_int32_core():
+    """Regression (ADVICE r5 #1): with -G at a config whose static score
+    bound fits int16 (bound = qlen*max_mat = 4000 <= ~31k limit), the
+    accumulated -20-per-transition path scores sink the optimum to -35980,
+    far below INT16_MIN. The pre-fix int16 core wrapped and failed its
+    backtrack (rc=-1); the -G-aware width selection must return the exact
+    analytic optimum."""
+    NativePOAGraph = _native_or_skip()
+    from abpoa_tpu.align import align_sequence_to_graph
+    L = 2000
+    g = _chain_with_decoy(NativePOAGraph, L, heavy=485165195)  # ~e^20
+    abpt = Params()
+    abpt.device = "native"
+    abpt.inc_path_score = True
+    abpt.finalize()
+    res = align_sequence_to_graph(g, abpt, np.zeros(L, dtype=np.uint8))
+    assert res.best_score == abpt.match * L - 20 * (L - 1)  # == -35980
+
+
+def test_phred_score_vec_matches_scalar_full_range():
+    """ADVICE r5 #2: vec == scalar over the whole 0..n_seq coverage range,
+    for a spread of cluster sizes."""
+    from abpoa_tpu.cons.consensus import phred_score, phred_score_vec
+    for n_seq in (1, 2, 3, 7, 33, 200, 1000):
+        cov = np.arange(n_seq + 1, dtype=np.int64)
+        vec = phred_score_vec(cov, n_seq)
+        scal = np.array([phred_score(int(c), n_seq) for c in cov],
+                        dtype=np.int64)
+        assert (vec == scal).all(), f"divergence at n_seq={n_seq}"
+
+
+def test_phred_score_vec_rejects_over_coverage():
+    from abpoa_tpu.cons.consensus import phred_score_vec
+    with pytest.raises(ValueError):
+        phred_score_vec(np.array([5]), 4)
+    assert phred_score_vec(np.array([], dtype=np.int64), 4).size == 0
+
+
+def test_native_cons_hb_dead_end_graph():
+    """ADVICE r5 #3: a graph whose heaviest branch dies before the sink
+    leaves the reverse BFS unable to reach the source; apg_cons_hb must
+    return an empty consensus instead of walking max_out[src] == -1."""
+    NativePOAGraph = _native_or_skip()
+    g = NativePOAGraph()
+    a = g.add_node(0)
+    b = g.add_node(1)
+    d = g.add_node(2)  # dead end: no out edges
+    g.add_edge(C.SRC_NODE_ID, a, True, 5, False, False, 0, 0)
+    g.add_edge(a, d, True, 5, False, False, 0, 0)
+    g.add_edge(C.SRC_NODE_ID, b, True, 1, False, False, 0, 0)
+    g.add_edge(b, C.SINK_NODE_ID, True, 1, False, False, 0, 0)
+    ids, bases, covs = g.consensus_hb()
+    assert len(ids) == len(bases) == len(covs) == 0
+
+
+def test_native_cons_hb_normal_graph_unchanged():
+    """The first-edge argmax seeding must keep the reference tie behavior
+    on a healthy graph: heaviest chain src->x->y->sink wins."""
+    NativePOAGraph = _native_or_skip()
+    g = NativePOAGraph()
+    x = g.add_node(1)
+    y = g.add_node(2)
+    z = g.add_node(3)
+    g.add_edge(C.SRC_NODE_ID, x, True, 3, False, False, 0, 0)
+    g.add_edge(x, y, True, 3, False, False, 0, 0)
+    g.add_edge(y, C.SINK_NODE_ID, True, 3, False, False, 0, 0)
+    g.add_edge(C.SRC_NODE_ID, z, True, 1, False, False, 0, 0)
+    g.add_edge(z, C.SINK_NODE_ID, True, 1, False, False, 0, 0)
+    ids, bases, covs = g.consensus_hb()
+    assert list(ids) == [x, y]
+    assert list(bases) == [1, 2]

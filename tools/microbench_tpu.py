@@ -144,6 +144,7 @@ def _ensure_sim(n_reads: int, ref_len: int = 10000) -> str:
 
 def task_e2e(device: str, n_reads: int, ref_len: int) -> None:
     import io
+    from abpoa_tpu import obs
     from abpoa_tpu.params import Params
     from abpoa_tpu.pipeline import Abpoa, msa_from_file
     path = _ensure_sim(n_reads, ref_len)
@@ -153,13 +154,15 @@ def task_e2e(device: str, n_reads: int, ref_len: int) -> None:
     t0 = time.perf_counter()
     msa_from_file(Abpoa(), abpt, path, io.StringIO())
     cold = time.perf_counter() - t0
+    obs.start_run()  # phase/counter/MFU attribution for the warm run
     t0 = time.perf_counter()
     msa_from_file(Abpoa(), abpt, path, io.StringIO())
     warm = time.perf_counter() - t0
     emit(task="e2e", platform=_platform(), device=device, n_reads=n_reads,
          ref_len=ref_len, cold_wall_s=round(cold, 3),
          warm_wall_s=round(warm, 3),
-         reads_per_sec=round(n_reads / warm, 3))
+         reads_per_sec=round(n_reads / warm, 3),
+         report=obs.summary(obs.finalize_report()))
 
 
 def _ensure_sim_seeded(n_reads: int, ref_len: int, seed: int) -> str:
@@ -197,17 +200,21 @@ def task_lockstep(device: str, k: int, n_reads: int, ref_len: int) -> None:
         seqs, weights = _ingest_records(ab, abpt, read_fastx(p))
         sets.append(seqs)
         wsets.append(weights)
+    from abpoa_tpu import obs
     t0 = time.perf_counter()
     outs = progressive_poa_fused_batch(sets, wsets, abpt)
     cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    outs = progressive_poa_fused_batch(sets, wsets, abpt)
-    warm = time.perf_counter() - t0
+    obs.start_run()  # warm-run lockstep counters (K / drain / no-op frac)
+    with obs.phase("align_fused"):
+        t0 = time.perf_counter()
+        outs = progressive_poa_fused_batch(sets, wsets, abpt)
+        warm = time.perf_counter() - t0
     ok = sum(o is not None for o in outs)
     emit(task="lockstep", platform=_platform(), device=device, k=k,
          n_reads=n_reads, ref_len=ref_len, sets_ok=ok,
          cold_wall_s=round(cold, 3), warm_wall_s=round(warm, 3),
-         reads_per_sec=round(k * n_reads / warm, 3))
+         reads_per_sec=round(k * n_reads / warm, 3),
+         report=obs.summary(obs.finalize_report()))
 
 
 def main():
