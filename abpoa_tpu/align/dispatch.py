@@ -20,6 +20,30 @@ from .result import AlignResult
 
 _BACKENDS: Dict[str, Callable] = {}
 
+# backend name the most recent _resolve actually selected — differs from
+# Params.device after a probe-timeout fallback, and telemetry labels
+# (per-read records, dp spans) must use it, not the requested device
+_LAST_RESOLVED = {"name": ""}
+
+
+def last_resolved(default: str = "") -> str:
+    return _LAST_RESOLVED["name"] or default
+
+
+def telemetry_backend(abpt: Params) -> tuple:
+    """(backend, fallback_reason) for per-read records: the kernel the
+    last dispatch actually ran, plus 'probe_timeout' when an accelerator
+    was requested but the probe rerouted to a host kernel. Host devices
+    always dispatch themselves, so only accelerator requests consult the
+    resolution state (which start_run resets between runs)."""
+    req = "jax" if abpt.device == "tpu" else abpt.device
+    if req not in ("jax", "pallas"):
+        return req, None
+    got = last_resolved(req)
+    if got != req:
+        return got, "probe_timeout"
+    return got, None
+
 
 def resolve_auto_device() -> str:
     """Pick the fastest available engine, the analog of the reference's
@@ -52,6 +76,7 @@ def _resolve(abpt: Params) -> Callable:
     from ..obs import count
     name = abpt.device
     if name in _BACKENDS:
+        _LAST_RESOLVED["name"] = name
         count(f"dispatch.{name}")
         return _BACKENDS[name]
     if name in ("jax", "tpu", "pallas", "native"):
@@ -75,6 +100,7 @@ def _resolve(abpt: Params) -> Callable:
                     name = "native"
                 except Exception:
                     name = "numpy"
+                _LAST_RESOLVED["name"] = name
                 count(f"dispatch.{name}")
                 return _BACKENDS[name]
             apply_platform_pin()
@@ -84,6 +110,7 @@ def _resolve(abpt: Params) -> Callable:
             if name == "tpu":
                 name = "jax"
         if name in _BACKENDS:
+            _LAST_RESOLVED["name"] = name
             count(f"dispatch.{name}")
             return _BACKENDS[name]
     raise ValueError(f"Unknown DP backend: {abpt.device}")
@@ -95,7 +122,11 @@ def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
         return AlignResult()
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
-    return _resolve(abpt)(g, abpt, beg_node_id, end_node_id, query)
+    fn = _resolve(abpt)
+    from ..obs import trace
+    with trace.span("dp:" + last_resolved(abpt.device), "dp",
+                    args={"rows": g.node_n, "qlen": len(query)}):
+        return fn(g, abpt, beg_node_id, end_node_id, query)
 
 
 def align_windows(g: POAGraph, abpt: Params, windows) -> list:
@@ -121,7 +152,10 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
             apply_platform_pin()
             from .jax_backend import align_windows_jax
             return align_windows_jax(g, abpt, windows)
-    return [fn(g, abpt, b, e, q) for b, e, q in windows]
+    from ..obs import trace
+    with trace.span("dp:" + last_resolved(abpt.device), "dp",
+                    args={"rows": g.node_n, "windows": len(windows)}):
+        return [fn(g, abpt, b, e, q) for b, e, q in windows]
 
 
 def align_sequence_to_graph(g: POAGraph, abpt: Params, query: np.ndarray) -> AlignResult:

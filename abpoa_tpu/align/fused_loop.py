@@ -1672,7 +1672,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                                  n_rc=n_reads if amb else 1)
     if use_pallas:
         from .pallas_fused import fits_vmem, fits_vmem_local_hbm
-    from ..obs import count, device_capture
+    from ..obs import compile_watch, count, device_capture, trace
     kahn_total = 0
     with device_capture("fused_loop"):
         for chunk_i in range(max_chunks):
@@ -1687,25 +1687,37 @@ def progressive_poa_fused(seqs: List[np.ndarray],
                       and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
                                               m=abpt.m, Qp=Qp))
             count("fused.chunks")
-            if chunk_i > 0:
-                # every grow-and-resume re-entry changes a shape or a
-                # static -> XLA recompiles the chunk
-                count("fused.recompiles")
             if use_pallas and not up and not up_hbm:
                 count("fallback.pallas_vmem")
             count("fused.dispatch.pallas" if up else
                   ("fused.dispatch.pallas_hbm" if up_hbm
                    else "fused.dispatch.xla"))
-            state = run_fused_chunk(
-                state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
-                qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
-                **_static_chunk_kwargs(
-                    abpt, W=W, max_ops=max_ops, plane16=plane16,
-                    int16_limit=int16_limit, use_pallas=up,
-                    pl_interpret=pl_interpret, record_paths=record_paths,
-                    amb=amb, local_m=local_m, pallas_hbm=up_hbm))
-            err = int(state.err)
-            done = int(state.read_idx)
+            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, K=1, plane16=plane16,
+                          pallas=bool(up), pallas_hbm=bool(up_hbm),
+                          gap_mode=abpt.gap_mode)
+            with trace.span("fused_chunk", "fused",
+                            args=dict(bucket, chunk=chunk_i)):
+                # the err/read_idx readback is the chunk's host sync: inside
+                # the bracket so the compile record's wall covers execution
+                with compile_watch("run_fused_chunk", run_fused_chunk,
+                                   bucket) as cw:
+                    state = run_fused_chunk(
+                        state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
+                        qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
+                        **_static_chunk_kwargs(
+                            abpt, W=W, max_ops=max_ops, plane16=plane16,
+                            int16_limit=int16_limit, use_pallas=up,
+                            pl_interpret=pl_interpret,
+                            record_paths=record_paths,
+                            amb=amb, local_m=local_m, pallas_hbm=up_hbm))
+                    err = int(state.err)
+                    done = int(state.read_idx)
+            if chunk_i > 0 and cw["compiled"]:
+                # a grow-and-resume re-entry whose bucket XLA had not
+                # already compiled this process (ground truth from the jit
+                # cache, not the re-entry count: a warm run replaying the
+                # same growth ladder hits the cache and recompiles nothing)
+                count("fused.recompiles")
             if err == ERR_OK and done >= n_reads:
                 break
             if err == ERR_BACKTRACK:
@@ -1875,11 +1887,11 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     # sets frozen by an unrecoverable per-set error; their err stays
     # non-OK so the vmapped while_loop skips them in later chunks
     failed = np.zeros(K, dtype=bool)
-    from ..obs import count, device_capture, observe
+    from ..obs import compile_watch, count, device_capture, observe, trace
     observe("lockstep.k", K)
     finished_prev = np.zeros(K, dtype=bool)
     with device_capture("fused_lockstep_batch"):
-        for _ in range(max_chunks):
+        for chunk_i in range(max_chunks):
             max_ops = N + Qp + 8
             inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
             up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
@@ -1907,10 +1919,22 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
                     st, sq, wg, ln, nr, qp, mat_d,
                     *_scalar_chunk_args(abpt, inf_min), **kwargs)
 
-            state = jax.vmap(chunk_one)(state, seqs_d, wgts_d, lens_d,
-                                        nreads_d, qp_d)
-            errs = np.asarray(state.err)
-            done = np.asarray(state.read_idx)
+            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, K=K, plane16=plane16,
+                          pallas=bool(up), pallas_hbm=bool(up_hbm),
+                          gap_mode=abpt.gap_mode)
+            with trace.span("lockstep_chunk", "fused",
+                            args=dict(bucket, chunk=chunk_i)):
+                # the jit cache doesn't track compiles under vmap, so the
+                # lockstep bracket passes no cache handle and compile
+                # detection falls back to first-sight-of-bucket
+                with compile_watch("run_fused_chunk[lockstep]", None,
+                                   bucket) as cw:
+                    state = jax.vmap(chunk_one)(state, seqs_d, wgts_d,
+                                                lens_d, nreads_d, qp_d)
+                    errs = np.asarray(state.err)
+                    done = np.asarray(state.read_idx)
+            if chunk_i > 0 and cw["compiled"]:
+                count("fused.recompiles")
             failed |= ~np.isin(errs, (ERR_OK,) + _RECOVERABLE_ERRS)
             finished_prev = failed | ((errs == ERR_OK) & (done >= n_reads_v))
             if finished_prev.all():

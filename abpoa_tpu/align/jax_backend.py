@@ -611,11 +611,27 @@ def align_windows_jax(g: POAGraph, abpt: Params,
              jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2)),
             jnp.int32(max(abpt.zdrop, 0)))
     n_dev = _window_mesh_size(len(padded))
-    if n_dev > 1:
-        packed = _dp_full_batch_sharded(*args, n_dev=n_dev, **statics)
-    else:
-        packed = _dp_full_batch(*args, **statics)
-    packed = np.asarray(packed)  # ONE device->host transfer for all windows
+    from ..obs import compile_watch, device_capture, trace
+    bucket = dict(B=B, R=R, Qp=Qp, P=P, O=O, SR=SR, n_dev=n_dev,
+                  gap_mode=abpt.gap_mode, align_mode=abpt.align_mode,
+                  banded=statics["banded"])
+    with trace.span("align_windows", "dp",
+                    args={"windows": len(snaps), "B": B, "R": R, "Qp": Qp}):
+        # the sharded variant rebuilds its shard_map per call, so only the
+        # unsharded path has a jit cache handle; the sharded path falls back
+        # to first-sight-of-bucket compile detection
+        with device_capture("window_batch"):
+            with compile_watch("dp_full_batch",
+                               None if n_dev > 1 else _dp_full_batch, bucket):
+                if n_dev > 1:
+                    packed = _dp_full_batch_sharded(*args, n_dev=n_dev,
+                                                    **statics)
+                else:
+                    packed = _dp_full_batch(*args, **statics)
+                # ONE device->host transfer for all windows (inside the
+                # compile bracket so its wall covers execution, not just
+                # the async dispatch)
+                packed = np.asarray(packed)
     return [_result_from_packed(g, abpt, packed[i], snaps[i], R, max_ops)
             for i in range(len(snaps))]
 

@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
                    help="capture a jax.profiler (XProf/TensorBoard) trace "
                         "around device dispatches into DIR")
+    p.add_argument("--trace", type=str, default=None, metavar="FILE",
+                   help="write a Chrome trace-event JSON timeline (phases, "
+                        "per-read/per-chunk/per-window spans, XLA compiles) "
+                        "to FILE ('-' for stdout; falls to stderr when "
+                        "stdout carries the consensus). Open in Perfetto "
+                        "(ui.perfetto.dev) or chrome://tracing")
     return p
 
 
@@ -127,8 +133,31 @@ def args_to_params(args: argparse.Namespace) -> Params:
     return abpt
 
 
+def report_main(argv) -> int:
+    """`abpoa-tpu report FILE` — render a `--report` JSON as a one-screen
+    phase/counter/percentile table (tools/report_view.py is the same
+    entry for checkouts without the console script installed)."""
+    import json
+    from .obs.report import render_report
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: abpoa-tpu report FILE [FILE ...]\n\n"
+              "render --report JSON run reports as human-readable tables "
+              "('-' reads stdin)", file=sys.stderr)
+        return 0 if argv else 1
+    for i, path in enumerate(argv):
+        with (sys.stdin if path == "-" else open(path)) as fp:
+            rep = json.load(fp)
+        if len(argv) > 1:
+            print(("" if i == 0 else "\n") + f"== {path} ==")
+        sys.stdout.write(render_report(rep))
+    return 0
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if raw[:1] == ["report"]:
+        return report_main(raw[1:])
+    args = build_parser().parse_args(raw)
     if args.input is None:
         build_parser().print_help(sys.stderr)
         return 1
@@ -136,6 +165,8 @@ def main(argv=None) -> int:
     from .utils import set_verbose, run_stats
     from . import obs
     obs.start_run()
+    if args.trace:
+        obs.trace_enable()
     if args.profile_dir:
         obs.set_profile_dir(args.profile_dir)
     set_verbose(abpt.verbose)
@@ -167,6 +198,16 @@ def main(argv=None) -> int:
             obs.write_report("-", fp=sys.stderr)
         else:
             obs.write_report(args.report)
+    if args.trace:
+        meta = {"input": args.input, "device": abpt.device}
+        if args.trace == "-" and out_fp is sys.stdout:
+            obs.export_chrome_trace("-", fp=sys.stderr, extra_meta=meta)
+        else:
+            obs.export_chrome_trace(args.trace, extra_meta=meta)
+        # the tracer is process-global: disarm it so an in-process caller
+        # (tests, library use) doesn't keep paying span overhead into a
+        # stale ring after this run's export
+        obs.trace_disable()
     return 0
 
 

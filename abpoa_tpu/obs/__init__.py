@@ -1,16 +1,28 @@
 """Run-telemetry subsystem: structured phase timers, counters, JSON run
-reports (versioned schema), an MFU model, and on-chip profiler capture
-hooks. See report.py for the schema, mfu.py for the model's assumptions,
+reports (versioned schema), an MFU model, per-read tail-latency records,
+a hierarchical span tracer (Chrome trace-event export, Perfetto-viewable),
+a compile log for the jitted entry points, and on-chip profiler capture
+hooks. See report.py for the schema, trace.py for the timeline contract,
+compile_log.py for compile detection, mfu.py for the model's assumptions,
 capture.py for the `--profile-dir` hooks; README "Run telemetry" and
-PERF.md document the consumer side (bench.py, chip_watcher)."""
+PERF.md document the consumer side (bench.py, perf_gate, chip_watcher)."""
+from . import trace
 from .capture import device_capture, profile_dir, set_profile_dir
+from .compile_log import compile_watch
 from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
-                     finalize_report, observe, phase, record_dp, report,
-                     set_enabled, start_run, summary, write_report)
+                     finalize_report, observe, phase, record_dp, record_read,
+                     report, set_enabled, start_run, summary, write_report)
+from .trace import (export_chrome_trace, instant, span, span_totals, tracer)
+from .trace import disable as trace_disable
+from .trace import enable as trace_enable
+from .trace import enabled as trace_enabled
 
 __all__ = [
     "SCHEMA", "SCHEMA_KEYS", "SCHEMA_VERSION", "RunReport",
-    "count", "observe", "phase", "record_dp", "report",
+    "count", "observe", "phase", "record_dp", "record_read", "report",
     "start_run", "set_enabled", "finalize_report", "write_report", "summary",
     "device_capture", "profile_dir", "set_profile_dir",
+    "trace", "trace_enable", "trace_disable", "trace_enabled",
+    "span", "instant", "span_totals", "export_chrome_trace", "tracer",
+    "compile_watch",
 ]

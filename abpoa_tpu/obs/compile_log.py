@@ -1,0 +1,158 @@
+"""Compile observability: every XLA compile becomes a span + a record.
+
+ROADMAP item 2 (AOT bucket ladder) needs to know WHICH (function, shape
+bucket, statics) signatures compile, how long each compile takes, and
+whether the persistent compilation cache served it — end-of-run counters
+like `fused.recompiles` cannot answer any of that. `compile_watch(...)`
+brackets the jitted entry points (the fused chunk, the window batch) and
+emits, per dispatch:
+
+- a structured record {fn, bucket, cache_hit, wall_s, xla_compile_s,
+  persistent_cache_hit} appended to the run's compile log (rendered as
+  the report's `compiles` key, bounded at RECORDS_CAP);
+- a `compile:<fn>` trace span when a compile actually happened, so the
+  timeline shows the stall where it occurred;
+- `compile.misses` / `compile.hits` counters.
+
+Compile detection is ground truth, not a heuristic: the jit wrapper's
+in-process executable cache (`fn._cache_size()`) grows exactly when XLA
+compiled (or loaded from the persistent cache) for a new signature.
+Hosts without `_cache_size` fall back to first-sight-of-key tracking,
+which matches jit semantics because the watched bucket IS the signature.
+XLA's own compile seconds and the persistent-cache hit/miss verdict come
+from `jax.monitoring` listeners ('/jax/backend_compile',
+'/jax/compilation_cache/cache_hits|misses'), registered lazily and only
+once — a jax-free (numpy/native) run never imports jax through here.
+
+Everything is host-side bookkeeping around dispatches the caller already
+makes; nothing adds device syncs.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+RECORDS_CAP = 512  # per-run record bound; counters keep exact totals
+
+# run-scoped (reset by report.start_run via reset_run)
+_RECORDS: list = []
+_DROPPED = 0
+
+# process-global (jit caches are process-global, so hit/miss must be too)
+_SEEN_KEYS: dict = {}
+
+# jax.monitoring accumulators (process-global, monotonic)
+_MON = {"backend_compile_s": 0.0, "backend_compiles": 0,
+        "pcache_hits": 0, "pcache_misses": 0, "registered": False}
+
+
+def reset_run() -> None:
+    global _RECORDS, _DROPPED
+    _RECORDS = []
+    _DROPPED = 0
+
+
+def run_records() -> list:
+    """This run's compile-log records (the report's `compiles` key)."""
+    return list(_RECORDS)
+
+
+def run_dropped() -> int:
+    return _DROPPED
+
+
+def _register_listeners() -> None:
+    """Idempotent jax.monitoring hookup; safe on hosts where the API or
+    the events are absent (everything degrades to wall-only records)."""
+    if _MON["registered"]:
+        return
+    _MON["registered"] = True
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+
+    def on_duration(event: str, duration: float, **kw) -> None:
+        if "backend_compile" in event:
+            _MON["backend_compile_s"] += duration
+            _MON["backend_compiles"] += 1
+
+    def on_event(event: str, **kw) -> None:
+        if event.endswith("compilation_cache/cache_hits"):
+            _MON["pcache_hits"] += 1
+        elif event.endswith("compilation_cache/cache_misses"):
+            _MON["pcache_misses"] += 1
+
+    try:
+        monitoring.register_event_duration_secs_listener(on_duration)
+        monitoring.register_event_listener(on_event)
+    except Exception:
+        pass
+
+
+def _cache_size(jfn) -> Optional[int]:
+    try:
+        return int(jfn._cache_size())
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def compile_watch(name: str, jfn, bucket: dict) -> Iterator[dict]:
+    """Bracket one dispatch of a jitted entry point.
+
+    `bucket` must carry the signature-determining values (shape buckets +
+    static args): it is both the record's attribution payload and the
+    fallback compile-detection key. Yields a dict whose `compiled` field
+    is valid after exit — drivers use it to count true recompiles.
+
+    The caller must place its host sync (the first `int(...)`/`asarray`
+    readback) INSIDE the bracket, so `wall_s` covers compile + execution
+    rather than async dispatch alone.
+    """
+    from .report import count, report
+    out = {"compiled": False}
+    if not report().enabled:
+        yield out
+        return
+    _register_listeners()
+    key = (name, tuple(sorted((k, str(v)) for k, v in bucket.items())))
+    before = _cache_size(jfn) if jfn is not None else None
+    mon0 = (_MON["pcache_hits"], _MON["pcache_misses"],
+            _MON["backend_compile_s"])
+    t0 = time.perf_counter()
+    # a dispatch that raises (device OOM, fallback path) leaves no record
+    # and no _SEEN_KEYS entry — a later successful dispatch of the same
+    # bucket must still be detectable as a compile
+    yield out
+    dt = time.perf_counter() - t0
+    after = _cache_size(jfn) if jfn is not None else None
+    if before is not None and after is not None:
+        compiled = after > before
+    else:
+        compiled = key not in _SEEN_KEYS
+    _SEEN_KEYS[key] = _SEEN_KEYS.get(key, 0) + 1
+    out["compiled"] = compiled
+    rec = {"fn": name, "bucket": dict(bucket),
+           "cache_hit": not compiled, "wall_s": round(dt, 6)}
+    if compiled:
+        hits_d = _MON["pcache_hits"] - mon0[0]
+        miss_d = _MON["pcache_misses"] - mon0[1]
+        xla_s = _MON["backend_compile_s"] - mon0[2]
+        # None when the monitoring events didn't fire (cache disabled,
+        # old jax): absence of evidence stays distinguishable from miss
+        rec["persistent_cache_hit"] = (True if hits_d > 0 else
+                                       (False if miss_d > 0 else None))
+        rec["xla_compile_s"] = round(xla_s, 6) if xla_s > 0 else None
+        count("compile.misses")
+        from . import trace
+        trace.add_span("compile:" + name, "compile", t0, dt,
+                       args=dict(bucket))
+    else:
+        count("compile.hits")
+    global _DROPPED
+    if len(_RECORDS) < RECORDS_CAP:
+        _RECORDS.append(rec)
+    else:
+        _DROPPED += 1

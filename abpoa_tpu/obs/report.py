@@ -17,24 +17,35 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import sys
 import time
 from typing import Dict, Iterator, Optional
 
+from . import compile_log as _clog
+from . import trace as _trace
+
 SCHEMA = "abpoa-tpu-run-report"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # top-level keys of the rendered report, in schema order. Goldened by
 # tests/test_obs.py: adding a key is a SCHEMA_VERSION bump.
+# v2 adds `reads` (per-read latency records -> p50/p95/p99, the item-1
+# service's SLO numbers) and `compiles` (the compile log, compile_log.py).
 SCHEMA_KEYS = ("schema", "schema_version", "created", "total_wall_s",
                "phase_wall_sum_s", "phases", "counters", "values",
-               "device", "mfu")
+               "reads", "compiles", "device", "mfu")
+
+# per-read record bound: percentiles over a truncated stream would lie,
+# so past the cap records are dropped AND counted (`reads.dropped`)
+READS_CAP = 100_000
 
 
 class RunReport:
     """Phase timers + counters + value summaries for one run."""
 
-    __slots__ = ("enabled", "t_start", "phases", "counters", "values")
+    __slots__ = ("enabled", "t_start", "phases", "counters", "values",
+                 "reads", "reads_dropped")
 
     def __init__(self) -> None:
         self.enabled = True
@@ -45,12 +56,18 @@ class RunReport:
         self.phases: Dict[str, list] = {}    # name -> [wall_s, calls]
         self.counters: Dict[str, int] = {}   # name -> int
         self.values: Dict[str, list] = {}    # name -> [count, sum, min, max]
+        # (wall_s, qlen, band_cols, backend, fallback, amortized)
+        self.reads: list = []
+        self.reads_dropped = 0
+        _clog.reset_run()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
         """Accumulating wall-clock timer; re-entries add up. Phases are
         non-overlapping by convention (pipeline.py) so their sum is a
-        partition of run wall time."""
+        partition of run wall time. The same (t0, dt) measurement feeds
+        the trace timeline, so phase spans reconcile with phase timers
+        exactly."""
         if not self.enabled:
             yield
             return
@@ -65,6 +82,7 @@ class RunReport:
             else:
                 rec[0] += dt
                 rec[1] += 1
+            _trace.add_span(name, "phase", t0, dt)
 
     def count(self, name: str, n: int = 1) -> None:
         if self.enabled:
@@ -106,7 +124,84 @@ class RunReport:
         self.count("dp.cells", cells)
         self.count("dp.cell_ops", cells * CELL_INT_OPS.get(gap_mode, 16))
 
+    def record_read(self, wall_s: float, qlen: int, band_cols: int,
+                    backend: str, fallback: Optional[str] = None,
+                    amortized: bool = False) -> None:
+        """One per-read latency record (the SLO stream): wall seconds, read
+        length, planned band extent, the backend that ran it, and the
+        fallback reason when a faster path was bypassed. `amortized` marks
+        records derived from a multi-read dispatch (fused loop / lockstep
+        batch) whose wall was split evenly across its reads — the per-read
+        number is then a share, not an independent measurement."""
+        if not self.enabled:
+            return
+        if len(self.reads) < READS_CAP:
+            self.reads.append((wall_s, qlen, band_cols, backend, fallback,
+                               amortized))
+        else:
+            self.reads_dropped += 1
+
     # ----------------------------------------------------------- rendering
+    def _reads_block(self) -> Optional[dict]:
+        """Tail-latency aggregation of the per-read records: nearest-rank
+        p50/p95/p99 over wall, plus backend/fallback attribution."""
+        if not self.reads and not self.reads_dropped:
+            return None
+        walls = sorted(r[0] for r in self.reads)
+        qlens = [r[1] for r in self.reads]
+        bands = [r[2] for r in self.reads]
+        backends: Dict[str, int] = {}
+        fallbacks: Dict[str, int] = {}
+        amortized = 0
+        for _w, _q, _b, backend, fb, am in self.reads:
+            backends[backend] = backends.get(backend, 0) + 1
+            if fb:
+                fallbacks[fb] = fallbacks.get(fb, 0) + 1
+            if am:
+                amortized += 1
+        n = len(walls)
+
+        def ms(x):
+            return round(x * 1e3, 4)
+
+        return {
+            "count": n,
+            "dropped": self.reads_dropped,
+            "amortized": amortized,
+            "backends": dict(sorted(backends.items())),
+            "fallbacks": dict(sorted(fallbacks.items())),
+            "wall_ms": {
+                "p50": ms(_percentile(walls, 0.50)),
+                "p95": ms(_percentile(walls, 0.95)),
+                "p99": ms(_percentile(walls, 0.99)),
+                "mean": ms(sum(walls) / n) if n else None,
+                "max": ms(walls[-1]) if n else None,
+            },
+            "qlen": {"min": min(qlens), "max": max(qlens),
+                     "mean": round(sum(qlens) / n, 1)} if n else None,
+            "band_cols": {"min": min(bands), "max": max(bands)} if n else None,
+        }
+
+    @staticmethod
+    def _compiles_block() -> Optional[dict]:
+        """The run's compile log (compile_log.py): per-dispatch records for
+        the jitted entry points, with XLA compile seconds and persistent-
+        cache verdicts when the monitoring events fired."""
+        recs = _clog.run_records()
+        dropped = _clog.run_dropped()
+        if not recs and not dropped:
+            return None
+        misses = sum(1 for r in recs if not r["cache_hit"])
+        xla = sum(r.get("xla_compile_s") or 0.0 for r in recs)
+        return {
+            "count": len(recs) + dropped,
+            "dropped": dropped,
+            "misses": misses,
+            "hits": len(recs) - misses,
+            "xla_compile_s": round(xla, 6),
+            "records": recs,
+        }
+
     def as_dict(self) -> dict:
         from .mfu import mfu_block
         total = time.perf_counter() - self.t_start
@@ -125,10 +220,22 @@ class RunReport:
             "phases": phases,
             "counters": dict(sorted(self.counters.items())),
             "values": values,
+            "reads": self._reads_block(),
+            "compiles": self._compiles_block(),
             "device": dev,
             "mfu": mfu_block(self, dev),
         }
         return rep
+
+
+def _percentile(sorted_vals, q: float):
+    """Nearest-rank percentile over an ascending list (no interpolation:
+    a reported p99 is a latency some real read actually paid)."""
+    if not sorted_vals:
+        return None
+    i = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[i]
 
 
 def _device_info() -> Optional[dict]:
@@ -160,6 +267,13 @@ def report() -> RunReport:
 def start_run() -> None:
     """Reset the global report; call at the top of each CLI/pyapi run."""
     _REPORT.reset()
+    # backend-resolution state is process-global too; a new run must not
+    # inherit the previous run's resolved kernel as a telemetry label
+    try:
+        from ..align.dispatch import _LAST_RESOLVED
+        _LAST_RESOLVED["name"] = ""
+    except Exception:
+        pass
 
 
 def set_enabled(flag: bool) -> None:
@@ -181,6 +295,12 @@ def observe(name: str, value: float) -> None:
 
 def record_dp(rows: int, band_cols: int, gap_mode: int) -> None:
     _REPORT.record_dp(rows, band_cols, gap_mode)
+
+
+def record_read(wall_s: float, qlen: int, band_cols: int, backend: str,
+                fallback: Optional[str] = None,
+                amortized: bool = False) -> None:
+    _REPORT.record_read(wall_s, qlen, band_cols, backend, fallback, amortized)
 
 
 def finalize_report() -> dict:
@@ -206,10 +326,92 @@ def summary(rep: dict) -> dict:
     per-phase walls plus the throughput-normalization numbers, small enough
     to live inside a BENCH_* `extra` blob."""
     mfu = rep.get("mfu") or {}
+    reads = rep.get("reads") or None
     return {
         "schema_version": rep["schema_version"],
         "phases": {k: v["wall_s"] for k, v in rep["phases"].items()},
         "dp_cells": rep["counters"].get("dp.cells", 0),
         "cell_updates_per_sec": mfu.get("cell_updates_per_sec"),
         "mfu": mfu.get("mfu"),
+        # per-read tail latency (the item-1 service's SLO numbers)
+        "read_wall_ms": ({q: reads["wall_ms"][q]
+                          for q in ("p50", "p95", "p99")}
+                         if reads else None),
     }
+
+
+def render_report(rep: dict) -> str:
+    """One-screen human rendering of a run report: phase table (sorted by
+    wall, with share of total), throughput line, per-read percentiles,
+    compile log totals, and the counter table. The reader for the JSON
+    the `--report` flag emits — `abpoa-tpu report FILE` and
+    tools/report_view.py both route here."""
+    lines = []
+    total = rep.get("total_wall_s") or 0.0
+    ver = rep.get("schema_version")
+    lines.append(f"run report (schema v{ver})  total {total:.3f}s")
+    dev = rep.get("device")
+    if dev:
+        lines.append(f"device: {dev.get('platform', '?')} "
+                     f"{dev.get('kind', '')} x{dev.get('count', 1)}".rstrip())
+
+    phases = rep.get("phases") or {}
+    if phases:
+        lines.append("")
+        lines.append(f"  {'phase':<16} {'wall_s':>9} {'share':>6} {'calls':>7}")
+        covered = 0.0
+        for name, ph in sorted(phases.items(),
+                               key=lambda kv: -kv[1]["wall_s"]):
+            w = ph["wall_s"]
+            covered += w
+            share = (100.0 * w / total) if total else 0.0
+            lines.append(f"  {name:<16} {w:>9.4f} {share:>5.1f}% "
+                         f"{ph['calls']:>7}")
+        if total:
+            lines.append(f"  {'(covered)':<16} {covered:>9.4f} "
+                         f"{100.0 * covered / total:>5.1f}%")
+
+    mfu = rep.get("mfu") or {}
+    if mfu:
+        cups = mfu.get("cell_updates_per_sec")
+        bits = [f"dp cells {rep['counters'].get('dp.cells', 0):,}"]
+        if cups:
+            bits.append(f"{cups:,.0f} cell-updates/s")
+        if mfu.get("mfu") is not None:
+            bits.append(f"MFU {100.0 * mfu['mfu']:.3f}%")
+        lines.append("")
+        lines.append("throughput: " + "  ".join(bits))
+
+    reads = rep.get("reads")
+    if reads:
+        wm = reads["wall_ms"]
+        lines.append("")
+        lines.append(f"reads: {reads['count']:,}"
+                     + (f" (+{reads['dropped']:,} dropped)"
+                        if reads.get("dropped") else "")
+                     + (f", {reads['amortized']:,} amortized"
+                        if reads.get("amortized") else ""))
+        lines.append(f"  wall ms  p50 {wm['p50']}  p95 {wm['p95']}  "
+                     f"p99 {wm['p99']}  max {wm['max']}")
+        if reads.get("backends"):
+            lines.append("  backends: " + "  ".join(
+                f"{k}={v}" for k, v in reads["backends"].items()))
+        if reads.get("fallbacks"):
+            lines.append("  fallbacks: " + "  ".join(
+                f"{k}={v}" for k, v in reads["fallbacks"].items()))
+
+    comp = rep.get("compiles")
+    if comp:
+        lines.append("")
+        lines.append(f"compiles: {comp['misses']} compiled / "
+                     f"{comp['hits']} cache hits"
+                     + (f", {comp['xla_compile_s']:.3f}s in XLA"
+                        if comp.get("xla_compile_s") else ""))
+
+    counters = rep.get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for name, v in sorted(counters.items()):
+            lines.append(f"  {name:<28} {v:,}")
+    return "\n".join(lines) + "\n"

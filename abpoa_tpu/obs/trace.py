@@ -1,0 +1,205 @@
+"""Event-level span tracer -> Chrome trace-event JSON (Perfetto-viewable).
+
+The RunReport (report.py) answers "where did the time go" in aggregate;
+this module answers "when" — a hierarchical timeline of the same phase
+names plus per-read / per-chunk / per-window / per-compile events, the
+shared-timeline attribution SeGraM reports per stage (arXiv:2205.05883).
+Armed by CLI `--trace FILE` (or `enable()` from the API), exported as the
+Chrome trace-event format, which both Perfetto (ui.perfetto.dev) and
+chrome://tracing load directly.
+
+Overhead contract: disabled (the default) every hook is one attribute
+check; enabled, a span is two `perf_counter()` calls and one ring-buffer
+store — no device syncs, no allocation beyond the event tuple. The ring
+buffer is bounded (default 65536 events): a pathological run overwrites
+its oldest events and reports the drop count in the export metadata
+instead of growing without bound. `RunReport.phase()` forwards its own
+(t0, dt) measurements here, so phase spans and phase timers are the same
+numbers by construction — the trace reconciles with the report exactly,
+not just "within noise".
+
+Single-writer assumption: events append without a lock (CPython list ops
+are atomic; the drivers are single-threaded). Multi-threaded writers
+would only ever interleave events, never corrupt the buffer.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Iterator, Optional
+
+DEFAULT_CAPACITY = 65536
+
+# event tuples: (kind, name, cat, t_start_s, dur_s, tid, args)
+# kind: "X" complete span | "i" instant
+_KIND_SPAN = "X"
+_KIND_INSTANT = "i"
+
+
+class Tracer:
+    """Bounded ring buffer of trace events on a monotonic clock."""
+
+    __slots__ = ("enabled", "capacity", "t0", "_buf", "_n", "_tids")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.enabled = False
+        self.capacity = capacity
+        self.reset()
+
+    def reset(self) -> None:
+        self.t0 = time.perf_counter()
+        self._buf: list = []
+        self._n = 0          # total events ever added (>= len(_buf))
+        self._tids: dict = {}  # thread ident -> dense tid
+
+    # ------------------------------------------------------------- recording
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = len(self._tids) + 1
+            self._tids[ident] = tid
+        return tid
+
+    def add_span(self, name: str, cat: str, t_start: float, dur: float,
+                 args: Optional[dict] = None) -> None:
+        """Record a completed span from caller-held timestamps (the path
+        RunReport.phase uses, so span == timer to the last bit)."""
+        ev = (_KIND_SPAN, name, cat, t_start, dur, self._tid(), args)
+        if self._n < self.capacity:
+            self._buf.append(ev)
+        else:
+            self._buf[self._n % self.capacity] = ev  # overwrite oldest
+        self._n += 1
+
+    def add_instant(self, name: str, cat: str,
+                    args: Optional[dict] = None) -> None:
+        ev = (_KIND_INSTANT, name, cat, time.perf_counter(), 0.0,
+              self._tid(), args)
+        if self._n < self.capacity:
+            self._buf.append(ev)
+        else:
+            self._buf[self._n % self.capacity] = ev
+        self._n += 1
+
+    # ------------------------------------------------------------- reading
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def events(self) -> list:
+        """Events oldest-first (unwrapping the ring)."""
+        if self._n <= self.capacity:
+            return list(self._buf)
+        k = self._n % self.capacity
+        return self._buf[k:] + self._buf[:k]
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    return _TRACER
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Arm tracing (resets the buffer and the timeline origin)."""
+    if capacity:
+        _TRACER.capacity = int(capacity)
+    _TRACER.reset()
+    _TRACER.enabled = True
+
+
+def disable() -> None:
+    _TRACER.enabled = False
+
+
+def enabled() -> bool:
+    return _TRACER.enabled
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "run",
+         args: Optional[dict] = None) -> Iterator[None]:
+    """Timed hierarchical span; nesting is expressed by time containment
+    (how the Chrome trace format builds its flame graph). Disabled: one
+    attribute check and a bare yield."""
+    if not _TRACER.enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _TRACER.add_span(name, cat, t0, time.perf_counter() - t0, args)
+
+
+def instant(name: str, cat: str = "run", args: Optional[dict] = None) -> None:
+    """Zero-duration marker (growth events, fallbacks, errors)."""
+    if _TRACER.enabled:
+        _TRACER.add_instant(name, cat, args)
+
+
+def add_span(name: str, cat: str, t_start: float, dur: float,
+             args: Optional[dict] = None) -> None:
+    """Record a span from caller-held timestamps (RunReport.phase)."""
+    if _TRACER.enabled:
+        _TRACER.add_span(name, cat, t_start, dur, args)
+
+
+# --------------------------------------------------------------------------- #
+# Chrome trace-event export                                                   #
+# --------------------------------------------------------------------------- #
+
+def to_chrome_trace(extra_meta: Optional[dict] = None) -> dict:
+    """The trace as a Chrome trace-event JSON object: `ph:"X"` complete
+    events with microsecond ts/dur on a run-relative timeline; metadata
+    records process naming and the drop count."""
+    t = _TRACER
+    pid = os.getpid()
+    out = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "abpoa-tpu"}},
+    ]
+    meta = {"dropped_events": t.dropped, "capacity": t.capacity}
+    if extra_meta:
+        meta.update(extra_meta)
+    out.append({"name": "trace_meta", "ph": "M", "pid": pid, "tid": 0,
+                "args": meta})
+    t0 = t.t0
+    for kind, name, cat, ts, dur, tid, args in t.events():
+        ev = {"name": name, "cat": cat, "ph": kind,
+              "ts": round((ts - t0) * 1e6, 3), "pid": pid, "tid": tid}
+        if kind == _KIND_SPAN:
+            ev["dur"] = round(dur * 1e6, 3)
+        else:
+            ev["s"] = "t"  # thread-scoped instant
+        if args:
+            ev["args"] = args
+        out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str, fp=None,
+                        extra_meta: Optional[dict] = None) -> None:
+    """`--trace FILE` sink ('-' = stdout, or `fp` when stdout is taken)."""
+    text = json.dumps(to_chrome_trace(extra_meta))
+    if path == "-":
+        (fp or sys.stdout).write(text + "\n")
+    else:
+        with open(path, "w") as out:
+            out.write(text + "\n")
+
+
+def span_totals(cat: Optional[str] = None) -> dict:
+    """Per-name wall sums over recorded spans (tests reconcile these with
+    the RunReport phase timers)."""
+    tot: dict = {}
+    for kind, name, c, _ts, dur, _tid, _args in _TRACER.events():
+        if kind == _KIND_SPAN and (cat is None or c == cat):
+            tot[name] = tot.get(name, 0.0) + dur
+    return tot

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from typing import IO, List, Sequence
 
 import numpy as np
@@ -58,7 +59,7 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
     import jax
     from ..align.fused_loop import (partition_by_length_bucket,
                                     progressive_poa_fused_batch)
-    from ..obs import count, observe
+    from ..obs import count, device_capture, observe, trace
     count("lockstep.groups")
     observe("lockstep.group_size", len(group))
     results: dict = {}
@@ -67,21 +68,40 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
     flat = []
     # same-Qp-bucket sub-batches keep the shared padding honest (a 100 bp
     # set must not pay a 10 kb set's planes); a failed bucket falls back
-    # alone — completed buckets keep their device results
-    for sub in partition_by_length_bucket(
-            [(e[0], e[2], e[3], e[1]) for e in group]):
-        flat.extend(sub)
-        try:
-            with jax.default_device(dev):
-                from ..obs import phase
-                with phase("align_fused"):
-                    outs.extend(progressive_poa_fused_batch(
-                        [e[1] for e in sub], [e[2] for e in sub], abpt))
-        except RuntimeError as e:
-            print(f"Warning: fused lockstep batch failed ({e}); "
-                  "falling back to sequential processing.", file=sys.stderr)
-            count("fallback.lockstep_to_sequential")
-            outs.extend([None] * len(sub))
+    # alone — completed buckets keep their device results. The outer
+    # device_capture makes the whole group ONE XProf capture (the inner
+    # per-sub-batch brackets degrade to trace annotations inside it).
+    with trace.span("lockstep_group", "fused",
+                    args={"k": len(group), "group": gi}), \
+            device_capture("lockstep_group"):
+        for sub in partition_by_length_bucket(
+                [(e[0], e[2], e[3], e[1]) for e in group]):
+            flat.extend(sub)
+            t0 = time.perf_counter()
+            try:
+                with jax.default_device(dev):
+                    from ..obs import phase
+                    with phase("align_fused"):
+                        outs.extend(progressive_poa_fused_batch(
+                            [e[1] for e in sub], [e[2] for e in sub], abpt))
+            except RuntimeError as e:
+                print(f"Warning: fused lockstep batch failed ({e}); "
+                      "falling back to sequential processing.",
+                      file=sys.stderr)
+                count("fallback.lockstep_to_sequential")
+                outs.extend([None] * len(sub))
+                continue
+            # amortized per-read SLO records (same contract as
+            # pyapi.msa_batch): the sub-batch wall split evenly across
+            # every read it carried
+            from ..obs import record_read
+            from ..pipeline import _band_cols
+            n_sub = sum(len(e[1]) for e in sub)
+            share = (time.perf_counter() - t0) / max(1, n_sub)
+            for e in sub:
+                for b in e[1]:
+                    record_read(share, len(b), _band_cols(abpt, len(b)),
+                                abpt.device, amortized=True)
     for (idx, _seqs, _w, ab), res in zip(flat, outs):
         if res is None:
             continue
@@ -134,14 +154,16 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
             devices = [None]
 
     def run_one(ab, i, fn):
+        from ..obs import trace
         abpt.batch_index = i + 1
         dev = devices[i % len(devices)]
-        if dev is None:
-            msa_from_file(ab, abpt, fn, out_fp)
-        else:
-            import jax
-            with jax.default_device(dev):
+        with trace.span(f"set:{i}", "set", args={"file": fn}):
+            if dev is None:
                 msa_from_file(ab, abpt, fn, out_fp)
+            else:
+                import jax
+                with jax.default_device(dev):
+                    msa_from_file(ab, abpt, fn, out_fp)
 
     if not lock:
         ab = Abpoa()

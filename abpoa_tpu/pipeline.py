@@ -6,6 +6,7 @@ abpoa_msa :402-472, abpoa_msa1 :474-540, abpoa_output :355-371).
 from __future__ import annotations
 
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import IO, List, Optional
 
@@ -72,8 +73,11 @@ def _band_cols(abpt: Params, qlen: int) -> int:
 
 
 def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarray],
-        exist_n_seq: int) -> None:
-    """Plain progressive POA, input order (src/abpoa_align.c:313-353)."""
+        exist_n_seq: int, fallback: Optional[str] = None) -> None:
+    """Plain progressive POA, input order (src/abpoa_align.c:313-353).
+
+    fallback: per-read-record attribution label when this host loop is
+    itself a fallback from a bypassed device path."""
     g = ab.graph
     n_seq = len(seqs)
     tot_n_seq = exist_n_seq + n_seq
@@ -81,6 +85,7 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
         qseq, weight = seqs[i], weights[i]
         qlen = len(qseq)
         read_id = exist_n_seq + i
+        t_read = time.perf_counter()
         res = AlignResult()
         if g.node_n > 2:
             obs.record_dp(g.node_n, _band_cols(abpt, qlen), abpt.gap_mode)
@@ -99,6 +104,13 @@ def poa(ab: Abpoa, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
                         ab.is_rc[read_id] = True
         with obs.phase("fusion"):
             g.add_alignment(abpt, qseq, weight, None, res.cigar, read_id, tot_n_seq, True)
+        dt = time.perf_counter() - t_read
+        from .align.dispatch import telemetry_backend
+        backend, auto_fb = telemetry_backend(abpt)
+        obs.record_read(dt, qlen, _band_cols(abpt, qlen), backend,
+                        fallback=fallback or auto_fb)
+        obs.trace.add_span(f"read:{read_id}", "read", t_read, dt,
+                           args={"qlen": qlen})
 
 
 def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
@@ -133,6 +145,7 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
             g = g.to_python(abpt)
         if g.node_n > 2:
             init_graph = g
+    t0 = time.perf_counter()
     try:
         with obs.phase("align_fused"):
             pg, _, is_rc = progressive_poa_fused(seqs, weights, abpt,
@@ -142,6 +155,13 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
               "falling back to the per-read loop.", file=sys.stderr)
         obs.count("fallback.fused_to_host")
         return False
+    # per-read latency records for the one-dispatch path: the fused wall
+    # split evenly across its reads (marked amortized — a share, not an
+    # independent measurement)
+    per_read = (time.perf_counter() - t0) / max(1, len(seqs))
+    for s in seqs:
+        obs.record_read(per_read, len(s), _band_cols(abpt, len(s)),
+                        abpt.device, amortized=True)
     ab.graph = pg
     if abpt.amb_strand:
         for i, flag in enumerate(is_rc):
@@ -278,7 +298,11 @@ def _msa_inner(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
 
     if plain_route(abpt):
         if not _run_fused_device(ab, abpt, seqs, weights, exist_n_seq):
-            poa(ab, abpt, seqs, weights, exist_n_seq)
+            # the reads now run per-read dispatches instead of the one
+            # fused dispatch — attribute that on every record
+            fb = ("fused_bypass"
+                  if abpt.device in ("jax", "tpu", "pallas") else None)
+            poa(ab, abpt, seqs, weights, exist_n_seq, fallback=fb)
     else:
         from .seed import anchor_poa_pipeline
         anchor_poa_pipeline(ab, abpt, seqs, weights, exist_n_seq)

@@ -7,6 +7,7 @@ Mirrors /root/reference/python/pyabpoa.pyx: `msa_aligner` with one-shot
 """
 from __future__ import annotations
 
+import time
 from typing import List
 
 import numpy as np
@@ -109,15 +110,23 @@ class msa_aligner:
                 weights = np.asarray(q, dtype=np.int64)
                 if (weights < 0).any():
                     raise ValueError("Qscores must be non-negative integers.")
+            from .pipeline import _band_cols
             if g.node_n > 2:
-                from .pipeline import _band_cols
                 obs.record_dp(g.node_n, _band_cols(abpt, len(bseq)),
                               abpt.gap_mode)
+            t_read = time.perf_counter()
             with obs.phase("align"):
                 res = align_sequence_to_graph(g, abpt, bseq)
             with obs.phase("fusion"):
                 g.add_alignment(abpt, bseq, weights, None, res.cigar,
                                 exist_n + read_i, tot_n, True)
+            dt = time.perf_counter() - t_read
+            from .align.dispatch import telemetry_backend
+            backend, auto_fb = telemetry_backend(abpt)
+            obs.record_read(dt, len(bseq), _band_cols(abpt, len(bseq)),
+                            backend, fallback=auto_fb)
+            obs.trace.add_span(f"read:{exist_n + read_i}", "read", t_read,
+                               dt, args={"qlen": len(bseq)})
             self.ab.append_read(seq=seq)
 
     def _collect(self, n_seq: int, ab: Abpoa = None) -> msa_result:
@@ -278,16 +287,35 @@ class msa_aligner:
             from .align.fused_loop import (partition_by_length_bucket,
                                            progressive_poa_fused_batch)
             order, outs = [], []
-            # same-Qp-bucket sub-batches; a failed bucket falls back alone
-            for sub in partition_by_length_bucket(
-                    list(zip(lockstep, enc_sets, wgt_sets))):
-                order.extend(e[0] for e in sub)
-                try:
-                    with obs.phase("align_fused"):
-                        outs.extend(progressive_poa_fused_batch(
-                            [e[1] for e in sub], [e[2] for e in sub], abpt))
-                except RuntimeError:
-                    outs.extend([None] * len(sub))
+            # same-Qp-bucket sub-batches; a failed bucket falls back alone.
+            # The outer device_capture makes the whole msa_batch ONE XProf
+            # capture under --profile-dir (multi-set coverage): the inner
+            # per-sub-batch brackets degrade to trace annotations inside it.
+            with obs.trace.span("msa_batch", "fused",
+                                args={"sets": len(lockstep)}), \
+                    obs.device_capture("msa_batch"):
+                from .pipeline import _band_cols
+                for sub in partition_by_length_bucket(
+                        list(zip(lockstep, enc_sets, wgt_sets))):
+                    order.extend(e[0] for e in sub)
+                    t0 = time.perf_counter()
+                    try:
+                        with obs.phase("align_fused"):
+                            outs.extend(progressive_poa_fused_batch(
+                                [e[1] for e in sub], [e[2] for e in sub],
+                                abpt))
+                    except RuntimeError:
+                        outs.extend([None] * len(sub))
+                        continue
+                    # amortized per-read SLO records: the sub-batch wall
+                    # split evenly across every read it carried
+                    n_sub = sum(len(e[1]) for e in sub)
+                    share = (time.perf_counter() - t0) / max(1, n_sub)
+                    for e in sub:
+                        for b in e[1]:
+                            obs.record_read(share, len(b),
+                                            _band_cols(abpt, len(b)),
+                                            abpt.device, amortized=True)
             for k, res in zip(order, outs):
                 if res is None:
                     continue

@@ -10,6 +10,7 @@ each solved independently by the DP kernel — the TPU batching unit.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -518,6 +519,7 @@ def anchor_poa(ab, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
         i = read_id_map[_i]
         read_id = exist_n_seq + i
         qlen = len(seqs[i])
+        t_read = time.perf_counter()
         whole_cigar: List[int] = []
         ai = 0 if _i == 0 else par_c[_i - 1]
         beg_id, beg_qpos = C.SRC_NODE_ID, 0
@@ -581,6 +583,14 @@ def anchor_poa(ab, abpt: Params, seqs: List[np.ndarray], weights: List[np.ndarra
             g.add_subgraph_alignment(abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, qseq,
                                      weight, qpos_to_node_id, whole_cigar,
                                      read_id, tot_n_seq, True)
+        from .align.dispatch import telemetry_backend
+        from .obs import record_read, trace
+        dt = time.perf_counter() - t_read
+        backend, auto_fb = telemetry_backend(abpt)
+        record_read(dt, qlen, _band_cols(abpt, qlen), backend,
+                    fallback=auto_fb)
+        trace.add_span(f"read:{read_id}", "read", t_read, dt,
+                       args={"qlen": qlen, "windows": len(specs)})
         tpos_to_node_id, qpos_to_node_id = qpos_to_node_id, tpos_to_node_id
         last_read_id = read_id
 

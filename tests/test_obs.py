@@ -1,15 +1,23 @@
-"""Run-telemetry subsystem tests (ISSUE 5 tentpole).
+"""Run-telemetry subsystem tests (ISSUE 5 tentpole + ISSUE 6 event layer).
 
 - schema golden: the report's top-level keys are stable and versioned
 - end-to-end sim2k: phases cover >=90% of wall, dispatch/band/cell
   counters are nonzero, the CLI --report flag emits the same schema
 - lockstep `-l` run: lockstep group/chunk counters and the fused phase
-- overhead guard: warm sim2k wall with reporting on is within noise of off
+- overhead guard: warm sim2k wall with reporting on (and with tracing on)
+  is within noise of off
 - MFU model: the estimate appears exactly when a known device kind ran
+- trace golden (ISSUE 6): `--trace` emits valid Chrome trace-event JSON
+  whose phase-span totals reconcile with the report phase timers
+- compile log: a second identical-bucket dispatch records a cache hit
+- perf gate: tools/perf_gate.py exit status flips on an injected
+  regression past the threshold
 """
 import io
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -18,6 +26,7 @@ import pytest
 from conftest import DATA_DIR
 
 SIM2K = os.path.join(DATA_DIR, "sim2k.fa")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _native_or_skip():
@@ -38,7 +47,7 @@ def test_report_schema_golden():
     rep = a.last_report
     assert tuple(rep.keys()) == obs.SCHEMA_KEYS
     assert rep["schema"] == obs.SCHEMA
-    assert rep["schema_version"] == obs.SCHEMA_VERSION == 1
+    assert rep["schema_version"] == obs.SCHEMA_VERSION == 2
     assert rep["counters"]["dispatch.numpy"] == 2
     assert rep["counters"]["dp.cells"] > 0
     assert {"align", "fusion", "consensus"} <= set(rep["phases"])
@@ -47,11 +56,19 @@ def test_report_schema_golden():
     assert rep["phase_wall_sum_s"] <= rep["total_wall_s"] + 1e-6
     band = rep["values"]["dp.band_width"]
     assert set(band) == {"count", "sum", "min", "max"} and band["max"] > 0
+    # v2: per-read latency records (one per input read, none amortized
+    # on the per-read host path)
+    reads = rep["reads"]
+    assert reads["count"] == 3 and reads["dropped"] == 0
+    assert reads["backends"] == {"numpy": 3}
+    wm = reads["wall_ms"]
+    assert 0 < wm["p50"] <= wm["p95"] <= wm["p99"] <= wm["max"]
     # summary() is the compact embedding bench/chip_watcher commit
     s = obs.summary(rep)
     assert set(s) == {"schema_version", "phases", "dp_cells",
-                      "cell_updates_per_sec", "mfu"}
+                      "cell_updates_per_sec", "mfu", "read_wall_ms"}
     assert s["dp_cells"] == rep["counters"]["dp.cells"]
+    assert s["read_wall_ms"] == {q: wm[q] for q in ("p50", "p95", "p99")}
 
 
 def test_cli_report_sim2k(tmp_path):
@@ -66,7 +83,7 @@ def test_cli_report_sim2k(tmp_path):
     assert rc == 0
     with open(rpt) as fp:
         rep = json.load(fp)
-    assert rep["schema_version"] == 1
+    assert rep["schema_version"] == 2
     assert rep["counters"]["dispatch.native"] > 0
     assert rep["counters"]["dp.cells"] > 0
     assert rep["values"]["dp.band_width"]["max"] > 0
@@ -100,10 +117,12 @@ def test_lockstep_report_counters():
 
 
 def test_overhead_guard_sim2k():
-    """Reporting must be free: warm sim2k wall with telemetry enabled
-    stays within noise of disabled (counters are host-side dict updates,
-    never device syncs). Bound is deliberately loose — this guards against
-    an accidental hot-loop sync, not scheduler jitter."""
+    """Reporting AND tracing must be free: warm sim2k wall with telemetry
+    enabled — and with the span tracer armed on top — stays within noise
+    of disabled (counters are host-side dict updates, spans are two
+    perf_counter calls and a ring-buffer store; never device syncs).
+    Bound is deliberately loose — this guards against an accidental
+    hot-loop sync, not scheduler jitter."""
     _native_or_skip()
     from abpoa_tpu import obs
     from abpoa_tpu.params import Params
@@ -120,12 +139,17 @@ def test_overhead_guard_sim2k():
     run_once()  # warm: .so load, file cache
     try:
         obs.set_enabled(True)
+        obs.trace_enable()
+        traced = min(run_once() for _ in range(2))
+        obs.trace_disable()
         on = min(run_once() for _ in range(2))
         obs.set_enabled(False)
         off = min(run_once() for _ in range(2))
     finally:
+        obs.trace_disable()
         obs.set_enabled(True)
     assert on <= off * 1.25 + 0.05, (on, off)
+    assert traced <= off * 1.25 + 0.05, (traced, off)
 
 
 def test_disabled_report_is_empty():
@@ -182,6 +206,204 @@ def test_phred_vec_used_by_native_cons_matches_python():
     cov = np.array([0, 1, 5, 17, 20], dtype=np.int64)
     assert phred_score_vec(cov, 20).tolist() == [
         phred_score(int(c), 20) for c in cov]
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 6: span tracer, compile log, per-read records, perf gate         #
+# --------------------------------------------------------------------- #
+
+def test_trace_schema_golden(tmp_path):
+    """Acceptance: `--trace` on sim2k emits valid Chrome trace-event JSON
+    (the schema Perfetto/chrome://tracing load) whose phase-span totals
+    reconcile with the RunReport phase timers to within 5%, and which
+    carries per-read and per-dispatch spans nested inside the phases."""
+    _native_or_skip()
+    from abpoa_tpu.cli import main
+    trc = str(tmp_path / "t.json")
+    rpt = str(tmp_path / "r.json")
+    out = str(tmp_path / "cons.fa")
+    rc = main([SIM2K, "--device", "native", "-o", out,
+               "--report", rpt, "--trace", trc])
+    assert rc == 0
+    with open(trc) as fp:
+        tr = json.load(fp)
+    evs = tr["traceEvents"]
+    assert tr["displayTimeUnit"] == "ms" and isinstance(evs, list)
+    assert {e["ph"] for e in evs} <= {"M", "X", "i"}
+    spans = [e for e in evs if e["ph"] == "X"]
+    for e in spans:  # the complete-event contract Perfetto parses
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["name"] and "pid" in e and "tid" in e
+    meta = next(e for e in evs if e["name"] == "trace_meta")
+    assert meta["args"]["dropped_events"] == 0
+    # per-read + per-dispatch events ride along the phase spans
+    cats = {e["cat"] for e in spans}
+    assert {"phase", "read", "dp"} <= cats
+    assert sum(1 for e in spans if e["cat"] == "read") == 20
+    # span totals == phase timers (same measurement by construction)
+    with open(rpt) as fp:
+        rep = json.load(fp)
+    tot = {}
+    for e in spans:
+        if e["cat"] == "phase":
+            tot[e["name"]] = tot.get(e["name"], 0.0) + e["dur"] / 1e6
+    assert set(tot) == set(rep["phases"])
+    for name, ph in rep["phases"].items():
+        assert tot[name] == pytest.approx(ph["wall_s"], rel=0.05), name
+
+
+def test_trace_ring_buffer_bounds():
+    """The ring buffer overwrites oldest past capacity and reports the
+    drop count instead of growing without bound."""
+    from abpoa_tpu.obs import trace
+    t = trace.Tracer(capacity=8)
+    t.enabled = True
+    for i in range(20):
+        t.add_span(f"s{i}", "x", float(i), 1.0)
+    assert t.dropped == 12
+    evs = t.events()
+    assert len(evs) == 8
+    assert [e[1] for e in evs] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_trace_disabled_records_nothing():
+    from abpoa_tpu import obs
+    obs.trace_disable()
+    n0 = obs.tracer()._n
+    with obs.span("x", "t"):
+        pass
+    obs.instant("y", "t")
+    obs.trace.add_span("z", "t", 0.0, 1.0)
+    assert obs.tracer()._n == n0
+
+
+def test_compile_log_second_dispatch_is_cache_hit():
+    """Satellite acceptance: a second identical-bucket dispatch of a
+    jitted entry point records a cache hit; a new bucket records a new
+    compile. Detection is the jit wrapper's executable cache, so this
+    holds regardless of how often the bracket ran in-process."""
+    import jax
+    import jax.numpy as jnp
+    from abpoa_tpu import obs
+    from abpoa_tpu.obs import compile_log
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    obs.start_run()
+    bucket = {"N": 8, "dtype": "int32"}
+    with obs.compile_watch("f", f, bucket):
+        int(f(jnp.zeros(8, jnp.int32))[0])
+    with obs.compile_watch("f", f, bucket):
+        int(f(jnp.ones(8, jnp.int32))[0])
+    # new shape -> new signature -> new compile
+    with obs.compile_watch("f", f, {"N": 16, "dtype": "int32"}):
+        int(f(jnp.zeros(16, jnp.int32))[0])
+    recs = compile_log.run_records()
+    assert [r["cache_hit"] for r in recs] == [False, True, False]
+    rep = obs.finalize_report()
+    comp = rep["compiles"]
+    assert comp["misses"] == 2 and comp["hits"] == 1
+    assert comp["count"] == 3 and comp["dropped"] == 0
+    assert rep["counters"]["compile.misses"] == 2
+    assert rep["counters"]["compile.hits"] == 1
+    for r in recs:
+        assert r["fn"] == "f" and r["wall_s"] >= 0
+        assert set(r["bucket"]) == {"N", "dtype"}
+
+
+def test_record_read_percentiles_and_cap():
+    """Nearest-rank percentiles over the per-read stream; past READS_CAP
+    records are dropped and counted, never silently truncated."""
+    # obs.report the *attribute* is a function; get the module itself
+    import importlib
+    R = importlib.import_module("abpoa_tpu.obs.report")
+    rep = R.RunReport()
+    for i in range(100):
+        rep.record_read((i + 1) / 1000.0, qlen=100 + i, band_cols=50,
+                        backend="native")
+    blk = rep._reads_block()
+    assert blk["count"] == 100 and blk["dropped"] == 0
+    # nearest-rank: p50 = 50th of 100 = 0.050 s, p99 = 99th = 0.099 s
+    assert blk["wall_ms"]["p50"] == pytest.approx(50.0)
+    assert blk["wall_ms"]["p95"] == pytest.approx(95.0)
+    assert blk["wall_ms"]["p99"] == pytest.approx(99.0)
+    assert blk["wall_ms"]["max"] == pytest.approx(100.0)
+    assert blk["qlen"] == {"min": 100, "max": 199, "mean": 149.5}
+    rep.reads = rep.reads[:0]
+    rep.reads_dropped = 0
+    old_cap = R.READS_CAP
+    try:
+        R.READS_CAP = 10
+        for i in range(15):
+            rep.record_read(0.001, 10, 5, "numpy", fallback="fused_bypass")
+    finally:
+        R.READS_CAP = old_cap
+    blk = rep._reads_block()
+    assert blk["count"] == 10 and blk["dropped"] == 5
+    assert blk["fallbacks"] == {"fused_bypass": 10}
+
+
+def test_report_viewer(tmp_path):
+    """`abpoa-tpu report FILE` renders the JSON report as a one-screen
+    table carrying the phase walls, percentiles, and counters."""
+    _native_or_skip()
+    from abpoa_tpu.cli import main
+    from abpoa_tpu.obs.report import render_report
+    rpt = str(tmp_path / "r.json")
+    rc = main([SIM2K, "--device", "native", "-o", str(tmp_path / "c.fa"),
+               "--report", rpt])
+    assert rc == 0
+    with open(rpt) as fp:
+        rep = json.load(fp)
+    text = render_report(rep)
+    assert "run report (schema v2)" in text
+    for name in rep["phases"]:
+        assert name in text
+    assert "p50" in text and "dispatch.native" in text
+    # the CLI subcommand routes to the same renderer
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["report", rpt]) == 0
+    assert buf.getvalue() == text
+
+
+def test_perf_gate_flips_on_regression(tmp_path):
+    """Acceptance: tools/perf_gate.py exits 0 on a measurement at
+    baseline and non-zero once an injected regression crosses the 15%
+    reads/s threshold (deterministic --current path, no live bench)."""
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = {"workload": "sim2k", "device": "native", "n_reads": 20,
+            "wall_s": 0.1, "reads_per_sec": 200.0,
+            "cell_updates_per_sec": 5.0e7}
+    bpath = str(tmp_path / "base.json")
+    cpath = str(tmp_path / "cur.json")
+    with open(bpath, "w") as fp:
+        json.dump(base, fp)
+    with open(cpath, "w") as fp:
+        json.dump(base, fp)
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, gate, "--baseline", bpath, "--current", cpath,
+             *extra], capture_output=True, text=True, cwd=REPO)
+
+    ok = run()
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "PASS" in ok.stdout
+    # 10% injected slowdown: inside the 15% threshold, still passes
+    assert run("--inject-slowdown", "1.10").returncode == 0
+    # ~20% injected slowdown: past the threshold on both metrics
+    bad = run("--inject-slowdown", "1.25")
+    assert bad.returncode == 1
+    assert "reads_per_sec regressed" in bad.stderr
+    # missing metric on either side is skipped, never a false failure
+    with open(cpath, "w") as fp:
+        json.dump({**base, "cell_updates_per_sec": None}, fp)
+    assert run().returncode == 0
 
 
 def test_device_capture_noop_without_dir(tmp_path):

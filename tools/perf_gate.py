@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Perf-regression gate: the bench trajectory, machine-enforced.
+
+Runs the quick bench tier (sim2k, 20 x 2 kb, warm, best host backend),
+compares reads/s and cell-updates/s against a checked-in baseline
+(tools/perf_baseline.json), and exits non-zero when either metric drops
+past its noise threshold — BENCH_r01->r05 stop depending on a human
+reading JSON files. Cell-updates/s is the cross-paper throughput judge
+(AnySeq/GPU, arXiv:2205.07610); reads/s is the product number.
+
+Noise thresholds (fractional drop vs baseline that FAILS the gate):
+
+- local / dev host (same machine as the baseline): defaults,
+  --rps-threshold 0.15 --cups-threshold 0.20. sim2k warm run-to-run
+  noise on an idle host is ~5-8%; 15% is outside it.
+- CI (.github/workflows/ci.yml `perf-gate` job): 0.60 for both. The
+  baseline was measured on the dev container; hosted runners differ by
+  up to ~2x in single-core throughput, so CI's job is catching
+  catastrophic regressions (native engine silently disabled, an
+  accidental device sync in the hot loop), not 15% drifts. Tightening
+  CI to 0.15 requires a runner-measured baseline (run with
+  --update-baseline on the runner and commit it).
+
+Faster metrics never fail; `--update-baseline` re-anchors after an
+intentional improvement. `--current FILE` gates a pre-measured result
+without re-running the bench (tests and multi-gate CI use this);
+`--inject-slowdown F` divides the measured metrics by F — the test hook
+that demonstrates the exit status actually flips.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+DEFAULT_BASELINE = os.path.join(TOOLS, "perf_baseline.json")
+
+# metric -> (baseline key, CLI threshold dest)
+METRICS = ("reads_per_sec", "cell_updates_per_sec")
+
+
+def run_quick_tier(repeats: int = 3) -> dict:
+    """Measure the quick tier: warm sim2k on the best host backend, best
+    of `repeats` timed runs (the sim2k warm wall is ~0.1 s, so a single
+    sample carries scheduler noise the thresholds would then have to
+    absorb). Returns the gate's `current` dict (also the baseline
+    schema)."""
+    sys.path.insert(0, REPO)
+    import bench
+    with open(os.path.join(REPO, "bench_baseline.json")) as fp:
+        wl = json.load(fp)["workloads"]["sim2k"]
+    path = os.path.join(REPO, wl["file"])
+    device = "numpy"
+    try:
+        from abpoa_tpu.native import load
+        if load() is not None:
+            device = "native"
+    except Exception:
+        pass
+    wall, summ = bench._time_run(device, path, warm=True), None
+    summ = bench.last_report_summary()
+    for _ in range(max(0, repeats - 1)):
+        w = bench._time_run(device, path, warm=False)
+        if w < wall:
+            wall, summ = w, bench.last_report_summary()
+    summ = summ or {}
+    return {
+        "workload": "sim2k",
+        "device": device,
+        "n_reads": wl["n_reads"],
+        "wall_s": round(wall, 4),
+        "reads_per_sec": round(wl["n_reads"] / wall, 3),
+        "cell_updates_per_sec": summ.get("cell_updates_per_sec"),
+        "read_wall_ms": summ.get("read_wall_ms"),
+        "host": {"machine": platform.machine(),
+                 "python": platform.python_version()},
+    }
+
+
+def compare(current: dict, baseline: dict, thresholds: dict) -> list:
+    """Pure gate decision: list of failure strings (empty = pass).
+    A metric only gates when both sides carry a positive number — a
+    baseline recorded without the native engine must not fail a host
+    that also lacks it, and vice versa."""
+    failures = []
+    for metric in METRICS:
+        thr = thresholds[metric]
+        base = baseline.get(metric)
+        cur = current.get(metric)
+        if not base or not cur or base <= 0:
+            print(f"[perf-gate] {metric}: no comparable numbers "
+                  f"(baseline={base}, current={cur}) — skipped")
+            continue
+        ratio = cur / base
+        verdict = "FAIL" if ratio < 1.0 - thr else "ok"
+        print(f"[perf-gate] {metric}: current={cur:.3g} baseline={base:.3g} "
+              f"ratio={ratio:.3f} (floor {1.0 - thr:.2f}) {verdict}")
+        if verdict == "FAIL":
+            failures.append(
+                f"{metric} regressed {100 * (1 - ratio):.1f}% "
+                f"(> {100 * thr:.0f}% threshold): "
+                f"{cur:.3g} vs baseline {base:.3g}")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="quick-tier perf-regression gate (see module docstring "
+                    "for the threshold contract)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="checked-in baseline JSON")
+    ap.add_argument("--current", default=None, metavar="FILE",
+                    help="gate this pre-measured result instead of running "
+                         "the bench")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the measured current result to FILE")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the measurement as the new baseline and "
+                         "exit 0 (intentional re-anchor)")
+    ap.add_argument("--rps-threshold", type=float, default=0.15,
+                    help="fractional reads/s drop that fails [%(default)s]")
+    ap.add_argument("--cups-threshold", type=float, default=0.20,
+                    help="fractional cell-updates/s drop that fails "
+                         "[%(default)s]")
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    metavar="F", help="divide measured metrics by F "
+                    "(test hook proving the gate flips)")
+    args = ap.parse_args(argv)
+
+    if args.current:
+        with open(args.current) as fp:
+            current = json.load(fp)
+    else:
+        current = run_quick_tier()
+    if args.inject_slowdown:
+        for metric in METRICS:
+            if current.get(metric):
+                current[metric] = current[metric] / args.inject_slowdown
+        print(f"[perf-gate] injected {args.inject_slowdown}x slowdown "
+              "(test hook)")
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(current, fp, indent=2)
+    if args.update_baseline:
+        with open(args.baseline, "w") as fp:
+            json.dump(current, fp, indent=2)
+            fp.write("\n")
+        print(f"[perf-gate] baseline updated: {args.baseline}")
+        return 0
+    if not os.path.isfile(args.baseline):
+        print(f"[perf-gate] no baseline at {args.baseline}; run with "
+              "--update-baseline to create one", file=sys.stderr)
+        return 2
+    with open(args.baseline) as fp:
+        baseline = json.load(fp)
+    failures = compare(current, baseline,
+                       {"reads_per_sec": args.rps_threshold,
+                        "cell_updates_per_sec": args.cups_threshold})
+    if failures:
+        for f in failures:
+            print(f"[perf-gate] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[perf-gate] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

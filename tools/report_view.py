@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Render `--report` JSON run reports as one-screen tables.
+
+Thin checkout-local wrapper over `abpoa-tpu report FILE` (cli.report_main)
+for environments without the console script installed:
+
+    python tools/report_view.py run_report.json
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from abpoa_tpu.cli import report_main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(report_main(sys.argv[1:]))
