@@ -64,12 +64,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import constants as C
+from ..compile import registry
+from ..compile.buckets import bucket as _bucket
+from ..compile.buckets import bucket_pow2 as _bucket_pow2
+from ..compile.buckets import grow_node_cap
+from ..compile.ladder import k_rung, qp_rung, reads_rung
 from ..params import Params
 from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
 # re-exported for device-path callers; defined in a jax-free module so
 # pre-probe callers never import this one
 from .eligibility import fused_config_eligible, fused_eligible  # noqa: F401
-from .jax_backend import _bucket, _bucket_pow2
+# imported for its side effects: persistent-cache wiring + the
+# dp_full_batch registry entry land before this module's first compile
+from . import jax_backend  # noqa: F401
 from .oracle import (INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit,
                      max_score_bound)
 
@@ -1495,8 +1502,9 @@ _RECOVERABLE_ERRS = (ERR_PROMOTE, ERR_NODE_CAP, ERR_OPS_CAP, ERR_BAND_CAP,
 
 
 def _plan_buckets(abpt: Params, qmax: int) -> Tuple[int, int, bool]:
-    """(Qp, W, local_mode) for a workload whose longest read is qmax."""
-    Qp = _bucket(qmax + 2, 128)
+    """(Qp, W, local_mode) for a workload whose longest read is qmax.
+    All rungs come from the declared ladder (compile/ladder.py)."""
+    Qp = qp_rung(qmax)
     local_m = abpt.align_mode == C.LOCAL_MODE
     if local_m:
         # local disables banding: every row spans the full query
@@ -1508,24 +1516,31 @@ def _plan_buckets(abpt: Params, qmax: int) -> Tuple[int, int, bool]:
 
 
 def partition_by_length_bucket(entries):
-    """Group (key, seqs, weights) triples by the planner's Qp bucket
-    (_plan_buckets) so lockstep sub-batches share honest padding: a short
-    set must not pay a long set's shared planes. Returns the groups in
-    ascending bucket order."""
+    """Group (key, seqs, weights) triples by the ladder's Qp rung — the
+    SAME `qp_rung` the chunk planner (_plan_buckets) keys on, so lockstep
+    sub-batching and the planner can never disagree about a read's
+    bucket — keeping the shared padding honest: a short set must not pay
+    a long set's planes. Returns the groups in ascending rung order."""
     parts: dict = {}
     for entry in entries:
         qmax = max((len(s) for s in entry[1]), default=0)
-        parts.setdefault(_bucket(qmax + 2, 128), []).append(entry)
+        parts.setdefault(qp_rung(qmax), []).append(entry)
     return [parts[k] for k in sorted(parts)]
 
 
-def _pad_read_set(seqs, weights, Qp: int, mat: np.ndarray, m: int):
-    """-> (seqs_pad, wgts_pad, lens, qp) host arrays for one read set."""
+def _pad_read_set(seqs, weights, Qp: int, mat: np.ndarray, m: int,
+                  n_rows: int = None):
+    """-> (seqs_pad, wgts_pad, lens, qp) host arrays for one read set.
+    n_rows pads the read axis to a ladder rung (reads_rung); padding rows
+    are zero-length and never touched — the loop stops at the traced
+    n_reads scalar — so sets of nearby sizes share one compiled chunk."""
     n = len(seqs)
-    seqs_pad = np.zeros((n, Qp), dtype=np.int32)
-    wgts_pad = np.ones((n, Qp), dtype=np.int32)
-    lens = np.zeros(n, dtype=np.int32)
-    qp = np.zeros((n, m, Qp), dtype=np.int32)
+    if n_rows is None:
+        n_rows = n
+    seqs_pad = np.zeros((n_rows, Qp), dtype=np.int32)
+    wgts_pad = np.ones((n_rows, Qp), dtype=np.int32)
+    lens = np.zeros(n_rows, dtype=np.int32)
+    qp = np.zeros((n_rows, m, Qp), dtype=np.int32)
     for i, s in enumerate(seqs):
         seqs_pad[i, : len(s)] = s
         wgts_pad[i, : len(s)] = weights[i]
@@ -1560,6 +1575,22 @@ def _static_chunk_kwargs(abpt: Params, *, W: int, max_ops: int, plane16: bool,
                 pallas_hbm=bool(pallas_hbm))
 
 
+def _pallas_variant(abpt: Params, use_pallas: bool, local_m: bool, W: int,
+                    plane16: bool, Qp: int) -> Tuple[bool, bool]:
+    """(up, up_hbm): which Pallas kernel variant (if any) this chunk's
+    statics select — the VMEM guard, shared by the single-set driver, the
+    lockstep driver and the AOT warmer so the compiled statics can never
+    drift apart."""
+    if not use_pallas:
+        return False, False
+    from .pallas_fused import fits_vmem, fits_vmem_local_hbm
+    up = fits_vmem(W, abpt.gap_mode, plane16, m=abpt.m, Qp=Qp)
+    up_hbm = (not up and local_m
+              and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
+                                      m=abpt.m, Qp=Qp))
+    return up, up_hbm
+
+
 def _grown_caps(errs, N: int, E: int, A: int, W: int, plane16: bool):
     """Collective growth policy: recoverable error codes -> new capacities.
     Returns (N, E, A, W, plane16, grew) where `grew` means the device state
@@ -1568,7 +1599,7 @@ def _grown_caps(errs, N: int, E: int, A: int, W: int, plane16: bool):
     from ..obs import count
     grew = False
     if any(e in (ERR_NODE_CAP, ERR_OPS_CAP, ERR_GRAPH_CAP) for e in errs):
-        N = _bucket(int(N * 1.7), 1024)
+        N = grow_node_cap(N)
         grew = True
         count("fused.grow.node")
     if any(e in (ERR_EDGE_CAP, ERR_GRAPH_CAP) for e in errs):
@@ -1616,6 +1647,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     init_graph: a restored host POAGraph to extend (incremental MSA `-i`);
     None starts from the empty graph."""
     n_reads = len(seqs)
+    n_rung = reads_rung(n_reads)  # padded read rows (ladder rung)
     qmax = max(len(s) for s in seqs)
     Qp, W, local_m = _plan_buckets(abpt, qmax)
     n0 = 0
@@ -1636,7 +1668,7 @@ def progressive_poa_fused(seqs: List[np.ndarray],
 
     mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
     seqs_pad, wgts_pad, lens, qp_all = _pad_read_set(
-        seqs, weights, Qp, mat, abpt.m)
+        seqs, weights, Qp, mat, abpt.m, n_rows=n_rung)
 
     seqs_d = jnp.asarray(seqs_pad)
     wgts_d = jnp.asarray(wgts_pad)
@@ -1662,17 +1694,15 @@ def progressive_poa_fused(seqs: List[np.ndarray],
     if init_graph is not None:
         state = _state_from_host_graph(
             init_graph, N, E, A,
-            n_reads=n_reads if record_paths else 1,
+            n_reads=n_rung if record_paths else 1,
             Pcap=Qp + 2 if record_paths else 8,
-            n_rc=n_reads if amb else 1)
+            n_rc=n_rung if amb else 1)
     else:
         state = init_fused_state(N, E, A,
-                                 n_reads=n_reads if record_paths else 1,
+                                 n_reads=n_rung if record_paths else 1,
                                  Pcap=Qp + 2 if record_paths else 8,
-                                 n_rc=n_reads if amb else 1)
-    if use_pallas:
-        from .pallas_fused import fits_vmem, fits_vmem_local_hbm
-    from ..obs import compile_watch, count, device_capture, trace
+                                 n_rc=n_rung if amb else 1)
+    from ..obs import count, device_capture, trace
     kahn_total = 0
     with device_capture("fused_loop"):
         for chunk_i in range(max_chunks):
@@ -1681,26 +1711,22 @@ def progressive_poa_fused(seqs: List[np.ndarray],
             # static VMEM guard: local mode (and band growth) can push W past
             # what the kernel's rings fit; local falls to the HBM-resident
             # variant, everything else to the XLA scan
-            up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
-                                          m=abpt.m, Qp=Qp)
-            up_hbm = (use_pallas and not up and local_m
-                      and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
-                                              m=abpt.m, Qp=Qp))
+            up, up_hbm = _pallas_variant(abpt, use_pallas, local_m, W,
+                                         plane16, Qp)
             count("fused.chunks")
             if use_pallas and not up and not up_hbm:
                 count("fallback.pallas_vmem")
             count("fused.dispatch.pallas" if up else
                   ("fused.dispatch.pallas_hbm" if up_hbm
                    else "fused.dispatch.xla"))
-            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, K=1, plane16=plane16,
-                          pallas=bool(up), pallas_hbm=bool(up_hbm),
-                          gap_mode=abpt.gap_mode)
+            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, reads=n_rung, K=1,
+                          plane16=plane16, pallas=bool(up),
+                          pallas_hbm=bool(up_hbm), gap_mode=abpt.gap_mode)
             with trace.span("fused_chunk", "fused",
                             args=dict(bucket, chunk=chunk_i)):
                 # the err/read_idx readback is the chunk's host sync: inside
                 # the bracket so the compile record's wall covers execution
-                with compile_watch("run_fused_chunk", run_fused_chunk,
-                                   bucket) as cw:
+                with registry.watch("run_fused_chunk", bucket) as cw:
                     state = run_fused_chunk(
                         state, seqs_d, wgts_d, lens_d, jnp.int32(n_reads),
                         qp_d, mat_d, *_scalar_chunk_args(abpt, inf_min),
@@ -1828,8 +1854,13 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     the normal grow-and-resume cycle).
     """
     K = len(seq_sets)
-    n_reads_v = np.array([len(s) for s in seq_sets], np.int32)
-    R = int(n_reads_v.max())
+    # ladder rungs for the set axis (pow2, padded with empty sets that
+    # finish before their first device step) and the per-set read axis —
+    # nearby group/set sizes share ONE compiled lockstep chunk
+    Kb = k_rung(K, mesh.size if mesh is not None else 1)
+    n_reads_v = np.zeros(Kb, np.int32)
+    n_reads_v[:K] = [len(s) for s in seq_sets]
+    R = reads_rung(int(n_reads_v.max()))
     qmax = max(len(s) for ss in seq_sets for s in ss)
     Qp, W, local_m = _plan_buckets(abpt, qmax)
     E = 8
@@ -1838,11 +1869,11 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     if _initial_caps is not None:
         N, E, A, W = _initial_caps
 
-    seqs_pad = np.zeros((K, R, Qp), dtype=np.int32)
-    wgts_pad = np.ones((K, R, Qp), dtype=np.int32)
-    lens = np.zeros((K, R), dtype=np.int32)
+    seqs_pad = np.zeros((Kb, R, Qp), dtype=np.int32)
+    wgts_pad = np.ones((Kb, R, Qp), dtype=np.int32)
+    lens = np.zeros((Kb, R), dtype=np.int32)
     mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
-    qp_all = np.zeros((K, R, abpt.m, Qp), dtype=np.int32)
+    qp_all = np.zeros((Kb, R, abpt.m, Qp), dtype=np.int32)
     for k, ss in enumerate(seq_sets):
         n = len(ss)
         (seqs_pad[k, :n], wgts_pad[k, :n], lens[k, :n],
@@ -1874,8 +1905,6 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     pl_interpret = jax.default_backend() != "tpu"
     record_paths = bool(abpt.use_read_ids)
     amb = bool(abpt.amb_strand)
-    if use_pallas:
-        from .pallas_fused import fits_vmem, fits_vmem_local_hbm
 
     def init_one():
         return init_fused_state(N, E, A,
@@ -1883,30 +1912,28 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
                                 Pcap=Qp + 2 if record_paths else 8,
                                 n_rc=R if amb else 1)
 
-    state = jax.tree.map(lambda x: _shard(jnp.stack([x] * K)), init_one())
+    state = jax.tree.map(lambda x: _shard(jnp.stack([x] * Kb)), init_one())
     # sets frozen by an unrecoverable per-set error; their err stays
     # non-OK so the vmapped while_loop skips them in later chunks
-    failed = np.zeros(K, dtype=bool)
-    from ..obs import compile_watch, count, device_capture, observe, trace
+    failed = np.zeros(Kb, dtype=bool)
+    from ..obs import count, device_capture, observe, trace
     observe("lockstep.k", K)
-    finished_prev = np.zeros(K, dtype=bool)
+    finished_prev = np.zeros(Kb, dtype=bool)
     with device_capture("fused_lockstep_batch"):
         for chunk_i in range(max_chunks):
             max_ops = N + Qp + 8
             inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
-            up = use_pallas and fits_vmem(W, abpt.gap_mode, plane16,
-                                          m=abpt.m, Qp=Qp)
-            up_hbm = (use_pallas and not up and local_m
-                      and fits_vmem_local_hbm(W, abpt.gap_mode, plane16,
-                                              m=abpt.m, Qp=Qp))
+            up, up_hbm = _pallas_variant(abpt, use_pallas, local_m, W,
+                                         plane16, Qp)
             count("lockstep.chunks")
             # a chunk re-entered while some sets are already finished only
             # drains the stragglers: finished sets no-op inside the vmapped
-            # while_loop but still occupy their batch slot
-            if finished_prev.any():
+            # while_loop but still occupy their batch slot (real sets only:
+            # K-rung padding slots are born finished and don't count)
+            if finished_prev[:K].any():
                 count("lockstep.drain_chunks")
             observe("lockstep.noop_set_fraction",
-                    float(finished_prev.mean()))
+                    float(finished_prev[:K].mean()))
 
             kwargs = _static_chunk_kwargs(
                 abpt, W=W, max_ops=max_ops, plane16=plane16,
@@ -1919,16 +1946,16 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
                     st, sq, wg, ln, nr, qp, mat_d,
                     *_scalar_chunk_args(abpt, inf_min), **kwargs)
 
-            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, K=K, plane16=plane16,
-                          pallas=bool(up), pallas_hbm=bool(up_hbm),
-                          gap_mode=abpt.gap_mode)
+            bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, reads=R, K=Kb,
+                          plane16=plane16, pallas=bool(up),
+                          pallas_hbm=bool(up_hbm), gap_mode=abpt.gap_mode)
             with trace.span("lockstep_chunk", "fused",
                             args=dict(bucket, chunk=chunk_i)):
                 # the jit cache doesn't track compiles under vmap, so the
                 # lockstep bracket passes no cache handle and compile
                 # detection falls back to first-sight-of-bucket
-                with compile_watch("run_fused_chunk[lockstep]", None,
-                                   bucket) as cw:
+                with registry.watch("run_fused_chunk[lockstep]", bucket,
+                                    use_handle=False) as cw:
                     state = jax.vmap(chunk_one)(state, seqs_d, wgts_d,
                                                 lens_d, nreads_d, qp_d)
                     errs = np.asarray(state.err)
@@ -1978,6 +2005,131 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
                  if amb else [False] * n_k)
         out.append((pg, is_rc))
     return out
+
+
+# --------------------------------------------------------------------------- #
+# compile-ladder integration (abpoa_tpu/compile): AOT warmers               #
+# --------------------------------------------------------------------------- #
+
+def _fused_anchor_signatures(abpt: Params, anchor) -> list:
+    """Map one warm anchor to the exact chunk signatures the planner can
+    request anywhere in the anchor's Qp-rung interval, plus `growth` rungs
+    of the node-capacity chain each start replays when the graph outgrows
+    its start bucket. Pure host math through the SAME planner functions
+    the drivers call, so warm and runtime cannot disagree."""
+    from ..compile.ladder import qmax_interval
+    Qp = qp_rung(anchor.qmax)
+    lo, hi = qmax_interval(Qp)
+    n_rung = reads_rung(anchor.n_reads)
+    int16_limit = int16_score_limit(abpt)
+    sigs, starts = [], set()
+    q = lo
+    while True:
+        Qp_q, W, _local = _plan_buckets(abpt, q)
+        assert Qp_q == Qp
+        N0 = _bucket(2 * (q + 2) + 64, 1024)
+        plane16 = max_score_bound(abpt, q, 2) <= int16_limit
+        if (N0, W, plane16) not in starts:
+            starts.add((N0, W, plane16))
+            N = N0
+            for _g in range(anchor.growth + 1):
+                sigs.append(dict(N=N, E=8, A=8, W=W, Qp=Qp, reads=n_rung,
+                                 plane16=plane16))
+                N = grow_node_cap(N)
+        if q >= hi:
+            break
+        q = min(q + 64, hi)  # catches every N/W/plane16 breakpoint
+    out, seen = [], set()
+    for s in sigs:
+        t = tuple(sorted(s.items()))
+        if t not in seen:
+            seen.add(t)
+            out.append(s)
+    return out
+
+
+def _warm_chunk_signature(abpt: Params, N: int, E: int, A: int, W: int,
+                          Qp: int, reads: int, plane16: bool,
+                          k: int = None) -> dict:
+    """Dispatch one fused-chunk signature on zero inputs with n_reads=0:
+    the while_loop exits before its first step, so the cost is pure XLA
+    compile (or a persistent-cache load). Argument construction mirrors
+    the drivers leaf for leaf — the zero-miss regression test would catch
+    any drift."""
+    from ..obs import compile_log
+    local_m = abpt.align_mode == C.LOCAL_MODE
+    use_pallas = abpt.device == "pallas"
+    pl_interpret = jax.default_backend() != "tpu"
+    record_paths = bool(abpt.use_read_ids)
+    amb = bool(abpt.amb_strand)
+    int16_limit = int16_score_limit(abpt)
+    inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+    max_ops = N + Qp + 8
+    up, up_hbm = _pallas_variant(abpt, use_pallas, local_m, W, plane16, Qp)
+    kwargs = _static_chunk_kwargs(
+        abpt, W=W, max_ops=max_ops, plane16=plane16,
+        int16_limit=int16_limit, use_pallas=up, pl_interpret=pl_interpret,
+        record_paths=record_paths, amb=amb, local_m=local_m,
+        pallas_hbm=up_hbm)
+    mat = jnp.asarray(np.ascontiguousarray(abpt.mat.astype(np.int32)))
+
+    def one_state():
+        return init_fused_state(N, E, A,
+                                n_reads=reads if record_paths else 1,
+                                Pcap=Qp + 2 if record_paths else 8,
+                                n_rc=reads if amb else 1)
+
+    name = "run_fused_chunk" if k is None else "run_fused_chunk[lockstep]"
+    bucket = dict(N=N, E=E, A=A, W=W, Qp=Qp, reads=reads,
+                  K=1 if k is None else k, plane16=plane16,
+                  pallas=bool(up), pallas_hbm=bool(up_hbm),
+                  gap_mode=abpt.gap_mode)
+    if k is None:
+        with registry.watch(name, bucket) as cw:
+            st = run_fused_chunk(
+                one_state(), jnp.zeros((reads, Qp), jnp.int32),
+                jnp.ones((reads, Qp), jnp.int32),
+                jnp.zeros(reads, jnp.int32), jnp.int32(0),
+                jnp.zeros((reads, abpt.m, Qp), jnp.int32), mat,
+                *_scalar_chunk_args(abpt, inf_min), **kwargs)
+            int(st.err)  # sync inside the bracket
+    else:
+        state = jax.tree.map(lambda x: jnp.stack([x] * k), one_state())
+
+        def chunk_one(st, sq, wg, ln, nr, qp):
+            return run_fused_chunk(st, sq, wg, ln, nr, qp, mat,
+                                   *_scalar_chunk_args(abpt, inf_min),
+                                   **kwargs)
+
+        with registry.watch(name, bucket, use_handle=False) as cw:
+            st = jax.vmap(chunk_one)(
+                state, jnp.zeros((k, reads, Qp), jnp.int32),
+                jnp.ones((k, reads, Qp), jnp.int32),
+                jnp.zeros((k, reads), jnp.int32),
+                jnp.zeros(k, jnp.int32),
+                jnp.zeros((k, reads, abpt.m, Qp), jnp.int32))
+            np.asarray(st.err)  # sync inside the bracket
+    recs = compile_log.run_records()
+    if recs and recs[-1]["fn"] == name:
+        return recs[-1]
+    return {"fn": name, "bucket": bucket, "cache_hit": not cw["compiled"]}
+
+
+def _warm_fused(abpt: Params, anchor) -> list:
+    return [_warm_chunk_signature(abpt, **sig)
+            for sig in _fused_anchor_signatures(abpt, anchor)]
+
+
+def _warm_fused_lockstep(abpt: Params, anchor) -> list:
+    k = k_rung(anchor.k or 8)
+    return [_warm_chunk_signature(abpt, k=k, **sig)
+            for sig in _fused_anchor_signatures(abpt, anchor)]
+
+
+registry.register_entry("run_fused_chunk",
+                        handle=lambda: run_fused_chunk, warmer=_warm_fused)
+registry.register_entry("run_fused_chunk[lockstep]",
+                        warmer=_warm_fused_lockstep)
 
 
 def _download_graph(state: FusedState, abpt: Params):

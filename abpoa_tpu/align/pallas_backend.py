@@ -12,11 +12,12 @@ import numpy as np
 import jax
 
 from .. import constants as C
+from ..compile.buckets import bucket as _bucket
+from ..compile.buckets import bucket_pow2 as _bucket_pow2
 from ..graph import POAGraph
 from ..params import Params
 from .dispatch import register_backend
-from .jax_backend import (_bucket, _bucket_pow2,
-                          align_sequence_to_subgraph_jax)
+from .jax_backend import align_sequence_to_subgraph_jax
 from .oracle import INT32_MIN, _DPState, _backtrack, _build_index_map, dp_inf_min
 from .result import AlignResult
 

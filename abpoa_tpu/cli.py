@@ -153,10 +153,68 @@ def report_main(argv) -> int:
     return 0
 
 
+def warm_main(argv) -> int:
+    """`abpoa-tpu warm [--ladder quick|full]` — AOT-precompile the declared
+    bucket ladder (compile/ladder.py) and populate the persistent XLA
+    compilation cache, so subsequent runs — this process, the bench, a
+    fresh server start — pay cache loads instead of first-sight compiles."""
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog="abpoa-tpu warm",
+        description="AOT-precompile the shape-bucket ladder and fill the "
+                    "persistent XLA compilation cache "
+                    "(~/.cache/abpoa_tpu/xla; override with "
+                    "ABPOA_TPU_XLA_CACHE_DIR, disable with "
+                    "ABPOA_TPU_XLA_CACHE=0)")
+    ap.add_argument("--ladder", choices=["quick", "full"], default="quick",
+                    help="rung tier: quick = smoke + 2 kb serve shapes; "
+                         "full = + 10 kb north-star, lockstep and "
+                         "seeded-window shapes [%(default)s]")
+    ap.add_argument("--device", default="jax",
+                    help="backend to warm statics for: jax | pallas "
+                         "[%(default)s]")
+    ap.add_argument("--report", default=None, metavar="FILE",
+                    help="write the warm summary JSON to FILE "
+                         "('-' for stdout)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the per-signature progress lines")
+    args = ap.parse_args(argv)
+    from .utils.probe import apply_platform_pin, jax_backend_reachable
+    if not jax_backend_reachable():
+        print("Error: JAX backend probe timed out (wedged accelerator "
+              "tunnel?); nothing to warm.", file=sys.stderr)
+        return 1
+    apply_platform_pin()
+    from . import obs
+    from .compile import warm_ladder
+    obs.start_run()
+    abpt = Params()
+    abpt.device = args.device
+    abpt.finalize()
+    summary = warm_ladder(tier=args.ladder, abpt=abpt, verbose=not args.quiet)
+    print(f"[abpoa-tpu warm] {summary['signatures']} signatures "
+          f"({summary['compiled']} compiled, "
+          f"{summary['persistent_cache_hits']} persistent-cache hits, "
+          f"{summary['xla_compile_s']}s in XLA) in {summary['wall_s']}s; "
+          f"cache: {summary['cache_dir']}", file=sys.stderr)
+    if args.report:
+        fp = sys.stdout if args.report == "-" else open(args.report, "w")
+        try:
+            json.dump(summary, fp, indent=2)
+            fp.write("\n")
+        finally:
+            if fp is not sys.stdout:
+                fp.close()
+    return 0
+
+
 def main(argv=None) -> int:
     raw = sys.argv[1:] if argv is None else list(argv)
     if raw[:1] == ["report"]:
         return report_main(raw[1:])
+    if raw[:1] == ["warm"]:
+        return warm_main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.input is None:
         build_parser().print_help(sys.stderr)

@@ -1,0 +1,32 @@
+"""Shared shape/compile management (ROADMAP item 2).
+
+One definition site for every shape-bucket decision the device paths make
+(`buckets`), an explicit declared rung ladder per jitted entry point
+(`ladder`), a registry of those entry points so `compile_watch` brackets
+them automatically (`registry`), AOT bucket-ladder precompilation
+(`warm`, the `abpoa-tpu warm` CLI), and persistent compilation-cache
+wiring (`cache`) so warmed rungs survive process restarts.
+
+Import of this package is jax-free: `cache` and `warm` import jax lazily
+so host-only runs (numpy/native) never pay a jax import through here.
+"""
+from .buckets import bucket, bucket_pow2, grow_node_cap, snap
+from .cache import cache_dir, enable_persistent_cache
+from .ladder import (LADDER, QUICK_TIER, FULL_TIER, WarmAnchor, k_rung,
+                     ladder_axes, on_ladder, qp_rung, reads_rung)
+from .registry import entry_names, jit_handle, register_entry, watch
+
+__all__ = [
+    "bucket", "bucket_pow2", "grow_node_cap", "snap",
+    "cache_dir", "enable_persistent_cache",
+    "LADDER", "QUICK_TIER", "FULL_TIER", "WarmAnchor",
+    "ladder_axes", "on_ladder", "qp_rung", "reads_rung", "k_rung",
+    "entry_names", "jit_handle", "register_entry", "watch",
+    "warm_ladder",
+]
+
+
+def warm_ladder(tier="quick", abpt=None, anchors=None, verbose=False):
+    """AOT-precompile the ladder (lazy import: pulls in jax)."""
+    from .warm import warm_ladder as _warm
+    return _warm(tier=tier, abpt=abpt, anchors=anchors, verbose=verbose)

@@ -1,0 +1,79 @@
+"""Bucket math, defined ONCE.
+
+Every static shape the device paths compile for is a rung of a fixed
+per-axis chain (ladder.py declares the chains). Three roundings exist:
+
+- `bucket(n, step)`: geometric x1.3 rounded up to `step` — the query/row
+  axes, where x1.3 bounds recompiles to O(log n) while capping padding
+  waste at 30%.
+- `bucket_pow2(n)`: power of two — degree/batch axes, where values are
+  tiny and pow2 keeps scatter tables lane-friendly.
+- `grow_node_cap(n)`: the node-capacity growth policy (x1.7 then snapped
+  to the 1024-step geometric chain) — deliberately faster than x1.3 so a
+  graph that outgrew its start bucket re-enters the loop few times.
+
+All three land on chain members by construction: `bucket(x, step)` walks
+the fixed chain step, step*1.3, ... regardless of x, so growth and start
+values share one rung table per axis and the AOT warmer (warm.py) can
+enumerate exactly the signatures the planners will request.
+
+This module is dependency-free (no jax, no numpy): the CLI parses
+`abpoa-tpu warm` arguments and perf_gate reads ladders without importing
+an accelerator stack.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def bucket(n: int, step: int) -> int:
+    """Smallest rung of the `step`-chain (x1.3, rounded up to `step`)
+    that is >= n. Single definition site — formerly triplicated across
+    jax_backend/fused_loop/pallas_backend."""
+    b = step
+    while b < n:
+        b = ((int(b * 1.3) + step - 1) // step) * step
+    return b
+
+
+def bucket_pow2(n: int) -> int:
+    """Smallest power of two >= n."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def grow_node_cap(n: int) -> int:
+    """Node-capacity growth rung: x1.7 snapped onto the 1024-step chain
+    (the fused loop's ERR_NODE_CAP/ERR_OPS_CAP/ERR_GRAPH_CAP policy)."""
+    return bucket(int(n * 1.7), 1024)
+
+
+def geom_chain(step: int, cap: int) -> Tuple[int, ...]:
+    """The explicit rung chain bucket(., step) draws from, up to cap."""
+    rungs = [step]
+    while rungs[-1] < cap:
+        rungs.append(((int(rungs[-1] * 1.3) + step - 1) // step) * step)
+    return tuple(rungs)
+
+
+def pow2_chain(lo: int, cap: int) -> Tuple[int, ...]:
+    rungs = []
+    p = 1
+    while p <= cap:
+        if p >= lo:
+            rungs.append(p)
+        p <<= 1
+    return tuple(rungs)
+
+
+def snap(n: int, rungs: Tuple[int, ...]) -> int:
+    """Smallest declared rung >= n (falls through to the last rung's
+    successor pattern only via the caller's bucket fn; planners never
+    exceed the declared caps in practice — the ladder property test
+    enforces it)."""
+    for r in rungs:
+        if r >= n:
+            return r
+    raise ValueError(f"value {n} beyond the declared ladder cap {rungs[-1]}")

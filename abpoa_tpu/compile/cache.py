@@ -1,0 +1,96 @@
+"""Persistent XLA compilation-cache wiring.
+
+Every cold process used to pay full first-sight XLA compiles (minutes per
+growth ladder on the fused loop). jax ships a persistent on-disk cache;
+this module points it at a stable per-user directory and lowers the
+entry thresholds so *all* of our entry points persist (the defaults skip
+compiles under 1 s and small executables — exactly the warm rungs
+`abpoa-tpu warm` exists to keep).
+
+Resolution order for the directory:
+
+1. ``ABPOA_TPU_XLA_CACHE=0``            -> disabled entirely
+2. pre-set jax config / ``JAX_COMPILATION_CACHE_DIR``  -> respected as-is
+3. ``ABPOA_TPU_XLA_CACHE_DIR``          -> used
+4. default                               -> ``~/.cache/abpoa_tpu/xla``
+
+Called from jax_backend at import (so every device path gets it before
+its first compile), from the warm CLI, and idempotent everywhere.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_SUBDIR = os.path.join("abpoa_tpu", "xla")
+
+_ENABLED: Optional[str] = None
+_DONE = False
+
+
+def _default_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(base, DEFAULT_SUBDIR)
+
+
+def cache_dir() -> Optional[str]:
+    """The directory the persistent cache resolves to, or None when
+    disabled. Pure env/config inspection — does not enable anything."""
+    if os.environ.get("ABPOA_TPU_XLA_CACHE", "") in ("0", "off", "false"):
+        return None
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    override = os.environ.get("ABPOA_TPU_XLA_CACHE_DIR")
+    if override:
+        return override
+    return _default_dir()
+
+
+def enable_persistent_cache() -> Optional[str]:
+    """Wire the jax persistent compilation cache (idempotent). Returns the
+    directory in effect, or None when disabled / jax unavailable. Lazy
+    jax import: host-only runs never pay it through here."""
+    global _ENABLED, _DONE
+    if _DONE:
+        return _ENABLED
+    _DONE = True
+    target = cache_dir()
+    if target is None:
+        return None
+    try:
+        import jax
+        # respect a dir the user already configured (env var above, or an
+        # explicit jax.config.update before we ran)
+        current = jax.config.jax_compilation_cache_dir
+        ours = not current and not os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if not current:
+            os.makedirs(target, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", target)
+            current = target
+        if ours:
+            # cache EVERY entry: our warm rungs are exactly the compiles
+            # the default 1 s / min-size thresholds would refuse to
+            # persist. Only when WE chose the directory — a host app that
+            # configured its own cache keeps its own persistence policy
+            # (importing this library must not bloat a foreign cache dir
+            # with every sub-second helper compile of unrelated jax code;
+            # our own >1 s entry-point compiles persist either way).
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except Exception:
+                pass  # knob absent on older jax: size gating stays default
+        _ENABLED = current
+    except Exception:
+        _ENABLED = None
+    return _ENABLED
+
+
+def reset_for_tests() -> None:
+    """Forget the idempotence latch (test hook)."""
+    global _ENABLED, _DONE
+    _ENABLED = None
+    _DONE = False

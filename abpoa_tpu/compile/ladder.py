@@ -1,0 +1,141 @@
+"""The declared bucket ladder: explicit rung tables per jitted entry point.
+
+Before this module the growth rungs were implicit in scattered x1.3/x1.7/
+pow2 call sites; a planner and a warmer could silently disagree about what
+shapes exist. Now each entry point declares its signature axes and the
+chain each axis draws from, `on_ladder` answers membership (the property
+test asserts every planner-requestable shape is a declared rung — no
+silent off-ladder compiles), and the warm tiers below declare the anchor
+workloads `abpoa-tpu warm` precompiles.
+
+Axes (fused chunk / lockstep / seeded-window batch):
+
+- Qp   padded query columns          GEOM_128 chain
+- N    node capacity                 GEOM_1024 chain (growth: x1.7 snapped)
+- W    band window width             pow2 >= 128 (growth: x2)
+- E/A  edge / aligned-group slots    pow2 (growth: x2)
+- R    window rows (seeded path)     GEOM_64 chain
+- P/O/SR/B  degree & batch axes      pow2
+- reads  padded read rows            pow2 >= 8 (new in round 8: the read
+         count used to be an unbucketed traced shape, so every distinct
+         set size compiled its own fused chunk)
+- K    lockstep set axis             pow2 (padding sets are empty: they
+         finish before their first device step)
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+from .buckets import bucket, bucket_pow2, geom_chain, pow2_chain, snap
+
+# declared chain caps: generous for the workloads the paper targets
+# (reads to ~128 kb, graphs to ~4M nodes); beyond these the planners
+# would raise in snap(), which the property test would catch first.
+GEOM_128 = geom_chain(128, 1 << 18)     # Qp: query columns
+GEOM_64 = geom_chain(64, 1 << 18)       # R: seeded-window rows
+GEOM_1024 = geom_chain(1024, 1 << 24)   # N: fused node capacity (+growth)
+POW2 = pow2_chain(1, 1 << 24)           # E/A/P/O/SR/B/K and W growth
+POW2_128 = pow2_chain(128, 1 << 24)     # W: band window width
+POW2_READS = pow2_chain(8, 1 << 17)     # padded read rows
+
+LADDER = {
+    "run_fused_chunk": {
+        "Qp": GEOM_128, "N": GEOM_1024, "W": POW2_128,
+        "E": POW2, "A": POW2, "reads": POW2_READS,
+    },
+    "run_fused_chunk[lockstep]": {
+        "Qp": GEOM_128, "N": GEOM_1024, "W": POW2_128,
+        "E": POW2, "A": POW2, "reads": POW2_READS, "K": POW2,
+    },
+    "dp_full_batch": {
+        "R": GEOM_64, "Qp": GEOM_128, "P": POW2, "O": POW2,
+        "SR": POW2, "B": POW2,
+    },
+}
+
+
+def ladder_axes(entry: str) -> dict:
+    return LADDER[entry]
+
+
+def on_ladder(entry: str, axis: str, value: int) -> bool:
+    """Is `value` a declared rung of `entry`'s `axis`?"""
+    return value in LADDER[entry][axis]
+
+
+# ---- planner rung helpers (the shared definitions drivers consume) ------- #
+
+def qp_rung(qmax: int) -> int:
+    """Padded-query rung for a workload whose longest read is qmax.
+    THE bucket key: _plan_buckets, partition_by_length_bucket and the
+    window planner all key through here, so lockstep sub-batching and
+    the chunk planner can never disagree about a read's bucket.
+    Snapped onto the declared chain: a read beyond the ladder cap
+    (~262 kb) raises here instead of compiling an off-ladder shape the
+    warmer can never precompile."""
+    return snap(qmax + 2, GEOM_128)
+
+
+def reads_rung(n: int) -> int:
+    """Padded read-row rung (>= 8, declared cap 131072 rows). Padding
+    rows are never touched: the fused loop stops at the traced n_reads
+    scalar. Raises past the cap — never a silent off-ladder compile."""
+    return snap(max(8, n), POW2_READS)
+
+
+def k_rung(k: int, mesh_size: int = 1) -> int:
+    """Lockstep set-axis rung; a mesh requires K divisible by its size.
+    For pow2 mesh sizes (every real mesh we target) the result stays on
+    the declared POW2 chain; a non-pow2 mesh's divisibility rounding can
+    leave it, which is accepted (the mesh, not the planner, fixes K)."""
+    r = snap(max(k, 1), POW2)
+    if mesh_size > 1:
+        r = ((max(r, mesh_size) + mesh_size - 1) // mesh_size) * mesh_size
+    return r
+
+
+# ---- warm tiers ---------------------------------------------------------- #
+
+class WarmAnchor(NamedTuple):
+    """One workload the AOT warmer precompiles: entry point + the workload
+    coordinates the planner maps to signatures. `growth` warms that many
+    node-capacity growth rungs past the start bucket (the chain a run
+    replays when the graph outgrows its start N); the warmer enumerates
+    every distinct start signature across the anchor's whole Qp-rung
+    interval, so any qmax landing in the same rung hits a warmed compile."""
+    entry: str
+    qmax: int
+    n_reads: int
+    growth: int = 1
+    k: Optional[int] = None       # lockstep only
+    windows: Optional[int] = None  # dp_full_batch only: window batch B
+
+
+# quick: the smoke/test scale plus the sim2k serve shape (2 kb reads).
+# Growth depth is deliberately shallow: each growth rung is its own XLA
+# compile whose cost grows with N (measured on the dev container: ~35 s
+# at N=4096, ~90-140 s at N>=6144 per signature), and a 20 x 2 kb
+# workload tops out one rung past its start bucket — deeper rungs would
+# double the quick tier's cold wall to warm shapes no 2 kb run reaches.
+QUICK_TIER: Tuple[WarmAnchor, ...] = (
+    WarmAnchor("run_fused_chunk", qmax=240, n_reads=8, growth=2),
+    WarmAnchor("run_fused_chunk", qmax=2200, n_reads=20, growth=1),
+)
+
+# full: quick + the north-star 10 kb consensus shape, the lockstep `-l`
+# group shape, and the seeded-window batch.
+FULL_TIER: Tuple[WarmAnchor, ...] = QUICK_TIER + (
+    WarmAnchor("run_fused_chunk", qmax=10000, n_reads=500, growth=4),
+    WarmAnchor("run_fused_chunk[lockstep]", qmax=10000, n_reads=10,
+               growth=2, k=8),
+    WarmAnchor("dp_full_batch", qmax=1000, n_reads=1, growth=0, windows=8),
+)
+
+TIERS = {"quick": QUICK_TIER, "full": FULL_TIER}
+
+
+def qmax_interval(qp: int) -> Tuple[int, int]:
+    """The [lo, hi] qmax interval that maps onto Qp rung `qp`."""
+    i = GEOM_128.index(qp)
+    lo = 1 if i == 0 else GEOM_128[i - 1] - 1  # qmax+2 > previous rung
+    return lo, qp - 2

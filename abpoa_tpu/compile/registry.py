@@ -1,0 +1,55 @@
+"""Registry of jitted entry points.
+
+Each device driver registers its jitted entry point(s) at import time:
+a lazy handle to the jit wrapper (so `compile_watch` gets ground-truth
+compile detection from the jit cache, and monkeypatched spies in tests
+are honored), plus an optional warmer the AOT ladder uses. Drivers then
+bracket dispatches with `watch(name, bucket)` instead of threading the
+handle themselves — the registry IS the list of things `abpoa-tpu warm`
+knows how to precompile.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+
+class Entry(NamedTuple):
+    handle: Optional[Callable]  # () -> jit wrapper (or None: no stable cache)
+    warmer: Optional[Callable]  # (abpt, anchor) -> list of warmed records
+
+
+_ENTRIES: Dict[str, Entry] = {}
+
+
+def register_entry(name: str, handle: Optional[Callable] = None,
+                   warmer: Optional[Callable] = None) -> None:
+    _ENTRIES[name] = Entry(handle, warmer)
+
+
+def entry_names() -> list:
+    return sorted(_ENTRIES)
+
+
+def jit_handle(name: str):
+    """The current jit wrapper for a registered entry point (None when the
+    entry has no stable in-process cache handle, e.g. vmapped lockstep)."""
+    e = _ENTRIES.get(name)
+    if e is None or e.handle is None:
+        return None
+    try:
+        return e.handle()
+    except Exception:
+        return None
+
+
+def warmer(name: str) -> Optional[Callable]:
+    e = _ENTRIES.get(name)
+    return e.warmer if e else None
+
+
+def watch(name: str, bucket: dict, use_handle: bool = True):
+    """compile_watch bracket for a registered entry point, with the jit
+    handle resolved automatically."""
+    from ..obs import compile_watch
+    return compile_watch(name, jit_handle(name) if use_handle else None,
+                         bucket)

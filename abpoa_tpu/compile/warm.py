@@ -1,0 +1,90 @@
+"""AOT bucket-ladder precompilation.
+
+`warm_ladder` maps each declared anchor workload (ladder.QUICK_TIER /
+FULL_TIER) through the *same planner code the drivers use* to the exact
+jit signatures they will request, then dispatches each entry point once
+on zero-filled inputs whose loop trip-count is zero — the dispatch cost
+is pure XLA compile (or a persistent-cache load on a warmed machine).
+Dispatches run inside the registry's `compile_watch` brackets, so the
+compile log records every signature with wall / xla_compile_s /
+persistent-cache verdict, and the in-process jit caches end up populated
+exactly as a real run would populate them: a subsequent workload in this
+process reports `compiles.misses == 0`, and a workload in a *fresh*
+process loads the rungs from the persistent cache instead of compiling.
+
+Drivers register their warmers in compile.registry at import; this module
+only orchestrates (and lazily imports the jax-bearing driver modules).
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional
+
+from . import registry
+from .cache import enable_persistent_cache
+from .ladder import TIERS, WarmAnchor
+
+
+def _default_params(device: str = "jax"):
+    from ..params import Params
+    abpt = Params()
+    abpt.device = device
+    return abpt.finalize()
+
+
+def warm_ladder(tier: str = "quick", abpt=None,
+                anchors: Optional[Iterable[WarmAnchor]] = None,
+                verbose: bool = False) -> dict:
+    """Precompile the ladder tier. Returns a summary dict:
+    {tier, signatures, compiled, cache_hits, persistent_cache_hits,
+     xla_compile_s, wall_s, records}."""
+    from .. import obs
+    enable_persistent_cache()
+    if abpt is None:
+        abpt = _default_params()
+    if anchors is None:
+        anchors = TIERS[tier]
+    # importing the drivers registers their entry points + warmers
+    from ..align import fused_loop  # noqa: F401
+    from ..align import jax_backend  # noqa: F401
+
+    t0 = time.perf_counter()
+    records = []
+    seen = set()
+    for anchor in anchors:
+        w = registry.warmer(anchor.entry)
+        if w is None:
+            records.append({"entry": anchor.entry, "skipped": "no warmer"})
+            continue
+        for rec in w(abpt, anchor):
+            key = (rec["fn"], tuple(sorted(
+                (k, str(v)) for k, v in rec["bucket"].items())))
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(rec)
+            if verbose:
+                import sys
+                pc = rec.get("persistent_cache_hit")
+                print("[warm] {fn} {bucket} wall={wall_s:.2f}s{extra}".format(
+                    fn=rec["fn"], bucket=rec["bucket"],
+                    wall_s=rec.get("wall_s") or 0.0,
+                    extra=(" (persistent-cache hit)" if pc
+                           else (" (compiled)" if not rec.get("cache_hit")
+                                 else " (jit-cache hit)"))),
+                    file=sys.stderr)
+    wall = time.perf_counter() - t0
+    compiled = sum(1 for r in records if not r.get("cache_hit", True))
+    return {
+        "tier": tier,
+        "signatures": len(records),
+        "compiled": compiled,
+        "cache_hits": sum(1 for r in records if r.get("cache_hit")),
+        "persistent_cache_hits": sum(
+            1 for r in records if r.get("persistent_cache_hit")),
+        "xla_compile_s": round(sum(
+            r.get("xla_compile_s") or 0.0 for r in records), 3),
+        "wall_s": round(wall, 3),
+        "cache_dir": enable_persistent_cache(),
+        "records": records,
+    }

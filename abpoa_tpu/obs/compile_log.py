@@ -140,10 +140,14 @@ def compile_watch(name: str, jfn, bucket: dict) -> Iterator[dict]:
         hits_d = _MON["pcache_hits"] - mon0[0]
         miss_d = _MON["pcache_misses"] - mon0[1]
         xla_s = _MON["backend_compile_s"] - mon0[2]
-        # None when the monitoring events didn't fire (cache disabled,
-        # old jax): absence of evidence stays distinguishable from miss
-        rec["persistent_cache_hit"] = (True if hits_d > 0 else
-                                       (False if miss_d > 0 else None))
+        # a persistent-cache MISS anywhere in the bracket wins: nested
+        # helper jits (jnp.zeros -> broadcast_in_dim) can HIT the cache
+        # inside a bracket whose own entry point compiled from scratch,
+        # and a 2-minute compile must not be labeled a cache load. None
+        # when neither event fired (cache disabled, old jax): absence of
+        # evidence stays distinguishable from miss
+        rec["persistent_cache_hit"] = (False if miss_d > 0 else
+                                       (True if hits_d > 0 else None))
         rec["xla_compile_s"] = round(xla_s, 6) if xla_s > 0 else None
         count("compile.misses")
         from . import trace

@@ -106,6 +106,29 @@ def _accelerator_reachable():
         return False
 
 
+def _warm_ladder_subprocess(tier="quick", timeout=1800, env=None,
+                            device="jax"):
+    """AOT-warm the declared bucket ladder in a child (`abpoa-tpu warm`,
+    ROADMAP item 2): every timed device child afterwards loads the warmed
+    rungs from the persistent compilation cache instead of paying
+    first-sight XLA compiles inside its (hard-capped) timing window.
+    `device` selects whose statics get baked — the pallas kernel variants
+    are distinct executables from the XLA-scan ones, so the pallas bench
+    row needs its own warm pass."""
+    try:
+        t0 = time.time()
+        subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "warm", "--ladder",
+             tier, "--device", device, "-q"],
+            capture_output=True, text=True, timeout=timeout, check=True,
+            env=env)
+        print(f"[bench] ladder warm ({tier}, {device}): "
+              f"{time.time() - t0:.1f}s", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] ladder warm failed (continuing cold): {e}",
+              file=sys.stderr)
+
+
 _LAST_REPORT = None
 
 
@@ -244,6 +267,13 @@ def main():
     if _accelerator_reachable():
         devices.append("jax")
         devices.append("pallas")
+        # device children share the persistent cache set above; the
+        # pallas variants are distinct executables, so warm both
+        _warm_ladder_subprocess("quick")
+        _warm_ladder_subprocess("quick", device="pallas")
+    # the fused-loop CPU row always runs: warm its (CPU-pinned) statics too
+    _warm_ladder_subprocess("quick",
+                            env=dict(os.environ, JAX_PLATFORMS="cpu"))
 
     per_backend = {}
     results = {}

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Lockstep K-scaling on the 8-way virtual CPU mesh (ROADMAP item 3's
+no-tunnel half).
+
+For K in {1, 4, 8}: K independent read sets (n reads x ref-len each,
+distinct seeds) advance through the fused progressive loop as ONE vmapped
+dispatch per chunk, the set axis sharded over min(K, 8) virtual CPU
+devices. Reports warm reads/s per K and the scaling ratio vs K=1, judged
+against PERF.md's decision rule: warm reads/s scaling >= 0.7*K means
+lockstep is the product default for `-l`-shaped workloads; worse means
+the vmapped fusion scatter (fused_loop.py) is the suspect and per-chip
+process parallelism over sets is the fallback.
+
+Writes BENCH_lockstep_cpu.json (one dict per K + the verdict). Run from
+the repo root:
+
+    python tools/bench_lockstep_cpu.py [--n-reads 10] [--ref-len 10000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+
+
+def _sim(path: str, n_reads: int, ref_len: int, seed: int) -> str:
+    if not os.path.isfile(path):
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests", "make_sim.py"),
+             "--ref-len", str(ref_len), "--n-reads", str(n_reads),
+             "--err", "0.1", "--seed", str(seed), "--out", path], check=True)
+    return path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-reads", type=int, default=10)
+    ap.add_argument("--ref-len", type=int, default=10000)
+    ap.add_argument("--ks", type=int, nargs="+", default=[1, 4, 8])
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_lockstep_cpu.json"))
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+    from abpoa_tpu import obs
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused_batch
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records
+
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.finalize()
+
+    all_sets, all_wsets = [], []
+    for s in range(max(args.ks)):
+        p = _sim(os.path.join("/tmp",
+                              f"lockstep_{args.n_reads}x{args.ref_len}.{s}.fa"),
+                 args.n_reads, args.ref_len, 700 + s)
+        seqs, weights = _ingest_records(Abpoa(), abpt, read_fastx(p))
+        all_sets.append(seqs)
+        all_wsets.append(weights)
+
+    rows = []
+    base_rps = None
+    for k in args.ks:
+        devs = np.array(jax.devices()[: min(k, 8)])
+        mesh = Mesh(devs, ("set",)) if len(devs) > 1 else None
+        sets, wsets = all_sets[:k], all_wsets[:k]
+        # cold pass: compiles (persistent-cache assisted) + execution
+        t0 = time.perf_counter()
+        outs = progressive_poa_fused_batch(sets, wsets, abpt, mesh=mesh)
+        cold = time.perf_counter() - t0
+        obs.start_run()
+        t0 = time.perf_counter()
+        outs = progressive_poa_fused_batch(sets, wsets, abpt, mesh=mesh)
+        warm = time.perf_counter() - t0
+        rep = obs.finalize_report()
+        ok = sum(o is not None for o in outs)
+        rps = k * args.n_reads / warm
+        row = {
+            "k": k, "mesh_devices": len(devs), "sets_ok": ok,
+            "n_reads": args.n_reads, "ref_len": args.ref_len,
+            "cold_wall_s": round(cold, 3), "warm_wall_s": round(warm, 3),
+            "reads_per_sec": round(rps, 3),
+            "scaling_vs_k1": None,
+            "counters": {c: v for c, v in rep["counters"].items()
+                         if c.startswith(("lockstep.", "fused."))},
+        }
+        if base_rps is None:
+            base_rps = rps
+        else:
+            row["scaling_vs_k1"] = round(rps / base_rps, 3)
+        rows.append(row)
+        print(f"[lockstep-cpu] K={k}: warm {warm:.2f}s, {rps:.2f} reads/s"
+              + (f", scaling {row['scaling_vs_k1']}x (rule >= {0.7 * k:.1f})"
+                 if row["scaling_vs_k1"] else ""), file=sys.stderr)
+
+    verdict = {}
+    for row in rows:
+        if row["scaling_vs_k1"] is not None:
+            verdict[f"k{row['k']}"] = {
+                "scaling": row["scaling_vs_k1"],
+                "rule": round(0.7 * row["k"], 2),
+                "pass": row["scaling_vs_k1"] >= 0.7 * row["k"],
+            }
+    out = {
+        "bench": "lockstep_k_scaling_cpu_mesh",
+        "host": "8-way virtual CPU mesh (xla_force_host_platform_device_count)",
+        "decision_rule": "warm reads/s scaling >= 0.7*K (PERF.md)",
+        "rows": rows,
+        "verdict": verdict,
+    }
+    with open(args.out, "w") as fp:
+        json.dump(out, fp, indent=2)
+        fp.write("\n")
+    print(f"[lockstep-cpu] wrote {args.out}: "
+          + json.dumps(verdict), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,11 +63,18 @@ def run_quick_tier(repeats: int = 3) -> dict:
         pass
     wall, summ = bench._time_run(device, path, warm=True), None
     summ = bench.last_report_summary()
+    misses = _report_compile_misses(bench.last_report())
     for _ in range(max(0, repeats - 1)):
         w = bench._time_run(device, path, warm=False)
+        misses = max(misses, _report_compile_misses(bench.last_report()))
         if w < wall:
             wall, summ = w, bench.last_report_summary()
     summ = summ or {}
+    # the host backends never dispatch jit, so their misses are trivially
+    # 0 — the recompile budget needs a real jit backend under it
+    dev_misses = _device_compile_misses(path)
+    if dev_misses is not None:
+        misses = max(misses, dev_misses)
     return {
         "workload": "sim2k",
         "device": device,
@@ -76,17 +83,84 @@ def run_quick_tier(repeats: int = 3) -> dict:
         "reads_per_sec": round(wl["n_reads"] / wall, 3),
         "cell_updates_per_sec": summ.get("cell_updates_per_sec"),
         "read_wall_ms": summ.get("read_wall_ms"),
+        "compile_misses": misses,
         "host": {"machine": platform.machine(),
                  "python": platform.python_version()},
     }
 
 
-def compare(current: dict, baseline: dict, thresholds: dict) -> list:
+def _report_compile_misses(report) -> int:
+    """In-run compile misses from a full obs report (0 when the run made
+    no jit dispatches at all — a host-backend run genuinely compiles
+    nothing)."""
+    comp = (report or {}).get("compiles") or {}
+    return int(comp.get("misses") or 0)
+
+
+def _device_compile_misses(path: str, timeout: int = 900):
+    """Compile misses of a WARM sim2k run on the jax backend, measured in
+    a CPU-pinned child (the tunnel-wedge rules from bench.py apply). The
+    child runs the workload once untimed — first-sight compiles or
+    persistent-cache loads land there — then once under the report: a
+    warm run that still misses has an in-run recompile (cache-key
+    instability, growth churn), which is exactly what the budget gates.
+    Returns None when jax is unavailable or the child fails: the budget
+    then rests on the host-backend count alone rather than failing the
+    gate on an environment problem."""
+    code = (
+        "import io, json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from abpoa_tpu import obs\n"
+        "from abpoa_tpu.params import Params\n"
+        "from abpoa_tpu.pipeline import Abpoa, msa_from_file\n"
+        "def one():\n"
+        "    abpt = Params(); abpt.device = 'jax'; abpt.finalize()\n"
+        "    msa_from_file(Abpoa(), abpt, %r, io.StringIO())\n"
+        "one()\n"
+        "obs.start_run()\n"
+        "one()\n"
+        "rep = obs.finalize_report()\n"
+        "print('MISSES', (rep.get('compiles') or {}).get('misses', 0))\n"
+        % path)
+    import subprocess
+    env = dict(os.environ, ABPOA_TPU_SKIP_PROBE="1")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env)
+        for line in r.stdout.splitlines():
+            if line.startswith("MISSES "):
+                return int(line.split()[1])
+    except Exception:
+        pass
+    return None
+
+
+def compare(current: dict, baseline: dict, thresholds: dict,
+            compile_misses_max=None) -> list:
     """Pure gate decision: list of failure strings (empty = pass).
     A metric only gates when both sides carry a positive number — a
     baseline recorded without the native engine must not fail a host
-    that also lacks it, and vice versa."""
+    that also lacks it, and vice versa.
+
+    compile_misses_max (CLI flag, falling back to the baseline's
+    `compile_misses_max` field): recompile budget — the warmed tier must
+    not compile in-run. Gates only when the current measurement carries a
+    `compile_misses` count (reports without a compiles block skip)."""
     failures = []
+    if compile_misses_max is None:
+        compile_misses_max = baseline.get("compile_misses_max")
+    misses = current.get("compile_misses")
+    if compile_misses_max is not None and misses is not None:
+        verdict = "FAIL" if misses > compile_misses_max else "ok"
+        print(f"[perf-gate] compile_misses: current={misses} "
+              f"budget={compile_misses_max} {verdict}")
+        if verdict == "FAIL":
+            failures.append(
+                f"compile_misses {misses} exceeds budget "
+                f"{compile_misses_max}: the run recompiled in-flight "
+                f"(warm the ladder or extend it — see abpoa-tpu warm)")
     for metric in METRICS:
         thr = thresholds[metric]
         base = baseline.get(metric)
@@ -129,6 +203,10 @@ def main(argv=None) -> int:
     ap.add_argument("--inject-slowdown", type=float, default=None,
                     metavar="F", help="divide measured metrics by F "
                     "(test hook proving the gate flips)")
+    ap.add_argument("--compile-misses-max", type=int, default=None,
+                    metavar="N", help="fail when the run reports more "
+                    "than N in-run compile misses (default: the "
+                    "baseline's compile_misses_max field, if any)")
     args = ap.parse_args(argv)
 
     if args.current:
@@ -146,6 +224,15 @@ def main(argv=None) -> int:
         with open(args.out, "w") as fp:
             json.dump(current, fp, indent=2)
     if args.update_baseline:
+        # the recompile budget is gate policy, not a measurement: survive
+        # re-anchors
+        try:
+            with open(args.baseline) as fp:
+                old = json.load(fp)
+            if "compile_misses_max" in old:
+                current["compile_misses_max"] = old["compile_misses_max"]
+        except Exception:
+            pass
         with open(args.baseline, "w") as fp:
             json.dump(current, fp, indent=2)
             fp.write("\n")
@@ -159,7 +246,8 @@ def main(argv=None) -> int:
         baseline = json.load(fp)
     failures = compare(current, baseline,
                        {"reads_per_sec": args.rps_threshold,
-                        "cell_updates_per_sec": args.cups_threshold})
+                        "cell_updates_per_sec": args.cups_threshold},
+                       compile_misses_max=args.compile_misses_max)
     if failures:
         for f in failures:
             print(f"[perf-gate] FAIL: {f}", file=sys.stderr)
