@@ -5,6 +5,14 @@ TPU-native analog of the reference's runtime CPUID dispatch
 `Params` selects the kernel implementation. "numpy" is the host oracle;
 "jax"/"pallas" run the banded DP on the accelerator (registered lazily so the
 package imports without a TPU present).
+
+Every dispatch runs through the resilience envelope (abpoa_tpu/resilience):
+resolution consults the per-backend circuit breaker (an open breaker demotes
+pallas -> jax -> native -> numpy for the rest of the run), device dispatches
+run under a watchdog deadline with classified-fault retry, results pass the
+output sanity guards, and any absorbed failure triggers a one-shot host
+re-run plus a `faults` record — never a silent wrong answer, never a dropped
+read.
 """
 from __future__ import annotations
 
@@ -20,10 +28,12 @@ from .result import AlignResult
 
 _BACKENDS: Dict[str, Callable] = {}
 
-# backend name the most recent _resolve actually selected — differs from
-# Params.device after a probe-timeout fallback, and telemetry labels
-# (per-read records, dp spans) must use it, not the requested device
-_LAST_RESOLVED = {"name": ""}
+# backend name the most recent _resolve/_host_rerun actually selected —
+# differs from Params.device after a probe-timeout fallback, a circuit-
+# breaker demotion, or a fault-triggered host re-run, and telemetry labels
+# (per-read records, dp spans) must use it, not the requested device.
+# `reason` says why they differ.
+_LAST_RESOLVED = {"name": "", "reason": None}
 
 
 def last_resolved(default: str = "") -> str:
@@ -32,17 +42,16 @@ def last_resolved(default: str = "") -> str:
 
 def telemetry_backend(abpt: Params) -> tuple:
     """(backend, fallback_reason) for per-read records: the kernel the
-    last dispatch actually ran, plus 'probe_timeout' when an accelerator
-    was requested but the probe rerouted to a host kernel. Host devices
-    always dispatch themselves, so only accelerator requests consult the
-    resolution state (which start_run resets between runs)."""
+    last dispatch actually ran, plus the reroute reason when a different
+    backend was requested ('probe_timeout' for the liveness-probe
+    fallback, 'breaker_open' after a circuit-breaker demotion,
+    'host_rerun' for a one-shot fault/guard re-run). The resolution state
+    is reset by start_run so runs don't inherit stale labels."""
     req = "jax" if abpt.device == "tpu" else abpt.device
-    if req not in ("jax", "pallas"):
-        return req, None
     got = last_resolved(req)
-    if got != req:
-        return got, "probe_timeout"
-    return got, None
+    if got == req:
+        return got, None
+    return got, _LAST_RESOLVED["reason"] or "rerouted"
 
 
 def resolve_auto_device() -> str:
@@ -60,8 +69,10 @@ def resolve_auto_device() -> str:
         from ..native import load
         if load() is not None:
             return "native"
-    except Exception:
-        pass
+    except (ImportError, OSError, RuntimeError) as e:
+        from ..obs import record_fault
+        record_fault("backend_init", backend="native",
+                     detail=str(e)[:200], action="auto_numpy")
     return "numpy"
 
 
@@ -72,16 +83,41 @@ def register_backend(name: str, fn: Callable) -> None:
 register_backend("numpy", align_sequence_to_subgraph_numpy)
 
 
+def _load_native_or_numpy() -> str:
+    """Register and return the best host backend name; faults are counted,
+    never eaten (the satellite contract: a broken native build is a
+    `faults` record + numpy fallback, not a silent pass)."""
+    try:
+        from . import native_backend  # noqa: F401  registers "native"
+        return "native"
+    except (ImportError, OSError, RuntimeError) as e:
+        from ..obs import count, record_fault
+        count("fallback.native_unavailable")
+        record_fault("backend_init", backend="native",
+                     detail=str(e)[:200], action="numpy")
+        return "numpy"
+
+
 def _resolve(abpt: Params) -> Callable:
     from ..obs import count
-    name = abpt.device
+    from ..resilience.breaker import breaker
+    name = "jax" if abpt.device == "tpu" else abpt.device
+    reason = None
+    # the circuit breaker demotes a failing backend for the rest of the
+    # run (resilience/breaker.py warns + reports the open, once)
+    eff = breaker().effective(name)
+    if eff != name:
+        count(f"breaker.demoted.{name}")
+        name = eff
+        reason = "breaker_open"
     if name in _BACKENDS:
         _LAST_RESOLVED["name"] = name
+        _LAST_RESOLVED["reason"] = reason
         count(f"dispatch.{name}")
         return _BACKENDS[name]
-    if name in ("jax", "tpu", "pallas", "native"):
+    if name in ("jax", "pallas", "native"):
         if name == "native":
-            from . import native_backend  # registers "native"
+            name = _load_native_or_numpy()
         else:
             # a wedged accelerator tunnel hangs the first in-process
             # jax.devices() forever; probe out-of-process first so the CLI
@@ -95,25 +131,98 @@ def _resolve(abpt: Params) -> Callable:
                     "Warning: JAX backend probe timed out (wedged "
                     "accelerator tunnel?); using the host kernel.")
                 count("fallback.jax_probe_timeout")
-                try:
-                    from . import native_backend  # registers "native"
-                    name = "native"
-                except Exception:
-                    name = "numpy"
+                name = _load_native_or_numpy()
                 _LAST_RESOLVED["name"] = name
+                _LAST_RESOLVED["reason"] = "probe_timeout"
                 count(f"dispatch.{name}")
                 return _BACKENDS[name]
             apply_platform_pin()
             from . import jax_backend  # lazy: registers "jax"
             if name == "pallas":
                 from . import pallas_backend  # registers "pallas"
-            if name == "tpu":
-                name = "jax"
         if name in _BACKENDS:
             _LAST_RESOLVED["name"] = name
+            _LAST_RESOLVED["reason"] = reason
             count(f"dispatch.{name}")
             return _BACKENDS[name]
     raise ValueError(f"Unknown DP backend: {abpt.device}")
+
+
+def _numpy_view(g: POAGraph, abpt: Params) -> POAGraph:
+    """The oracle walks Python Node objects; when the run's graph engine
+    is native (a device/native config deep in the degradation ladder),
+    export a read-only copy. Node ids are preserved, so the resulting
+    cigar fuses back into the original graph. Re-sorted on the Python
+    side: the export carries the topo order but not the adaptive-band
+    position arrays the oracle needs. Fault path only — never hot."""
+    if not getattr(g, "is_native", False):
+        return g
+    g2 = g.to_python(abpt)
+    g2.is_topological_sorted = False
+    g2.topological_sort(abpt)
+    return g2
+
+
+def _host_rerun(g: POAGraph, abpt: Params, beg_node_id: int,
+                end_node_id: int, query: np.ndarray,
+                exclude: str = "") -> AlignResult:
+    """One-shot host re-run after a failed/garbage dispatch: native when
+    available (and not itself the failed backend), else the numpy oracle
+    — the authoritative floor of the degradation ladder."""
+    from ..obs import count
+    for cand in ("native", "numpy"):
+        if cand == exclude:
+            continue
+        if cand == "native" and _load_native_or_numpy() != "native":
+            continue
+        fn = _BACKENDS.get(cand)
+        if fn is None:
+            continue
+        g2 = _numpy_view(g, abpt) if cand == "numpy" else g
+        count(f"dispatch.rerun.{cand}")
+        _LAST_RESOLVED["name"] = cand
+        _LAST_RESOLVED["reason"] = "host_rerun"
+        return fn(g2, abpt, beg_node_id, end_node_id, query)
+    raise RuntimeError("no host backend available for the re-run")
+
+
+def _dispatch_resilient(fn: Callable, name: str, g: POAGraph, abpt: Params,
+                        beg_node_id: int, end_node_id: int,
+                        query: np.ndarray) -> AlignResult:
+    """One DP dispatch under the resilience envelope: injection points,
+    watchdog (device backends only — host kernels cannot hang and must
+    not pay a thread spawn per read), fault classification + breaker, the
+    output guards, and the one-shot host re-run."""
+    from .. import resilience as rz
+    if name == "numpy":
+        # the numpy oracle is the degradation ladder's floor and the
+        # correctness reference: nothing to demote to, nothing to guard
+        # against — its errors are real bugs and must propagate. It can
+        # be reached with a native graph engine (breaker walked the whole
+        # ladder mid-run), hence the view shim.
+        return fn(_numpy_view(g, abpt), abpt, beg_node_id, end_node_id,
+                  query)
+    if not rz.enabled():
+        return fn(g, abpt, beg_node_id, end_node_id, query)
+    from ..obs import count, record_fault
+    try:
+        res = rz.guarded_device_call(
+            f"dp:{name}", name,
+            lambda: fn(g, abpt, beg_node_id, end_node_id, query))
+    except rz.DispatchFailed:
+        count("fallback.dp_host_rerun")
+        return _host_rerun(g, abpt, beg_node_id, end_node_id, query,
+                           exclude=name)
+    res = rz.inject.corrupt_result(res)
+    viol = rz.guards.align_result_violation(res, len(query), g.node_n, abpt)
+    if viol is not None:
+        count("guard.dp_violation")
+        record_fault("garbage_output", backend=name, detail=viol,
+                     action="host_rerun")
+        rz.breaker().record_failure(name, "garbage_output")
+        return _host_rerun(g, abpt, beg_node_id, end_node_id, query,
+                           exclude=name)
+    return res
 
 
 def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
@@ -123,10 +232,12 @@ def align_sequence_to_subgraph(g: POAGraph, abpt: Params, beg_node_id: int,
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
     fn = _resolve(abpt)
+    name = last_resolved(abpt.device)
     from ..obs import trace
-    with trace.span("dp:" + last_resolved(abpt.device), "dp",
+    with trace.span("dp:" + name, "dp",
                     args={"rows": g.node_n, "qlen": len(query)}):
-        return fn(g, abpt, beg_node_id, end_node_id, query)
+        return _dispatch_resilient(fn, name, g, abpt, beg_node_id,
+                                   end_node_id, query)
 
 
 def align_windows(g: POAGraph, abpt: Params, windows) -> list:
@@ -134,7 +245,9 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
 
     Device backends batch all windows into one dispatch
     (jax_backend.align_windows_jax); host backends run them sequentially.
-    Results are identical either way.
+    Results are identical either way. The batched device dispatch runs
+    under the same resilience envelope as single dispatches; on failure
+    the windows re-run sequentially on the host kernels.
     """
     if not windows:
         return []
@@ -142,20 +255,50 @@ def align_windows(g: POAGraph, abpt: Params, windows) -> list:
         return [AlignResult() for _ in windows]
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
-    fn = _resolve(abpt)  # also validates the backend name
-    if len(windows) > 1 and abpt.device in ("jax", "tpu", "pallas"):
-        # _resolve may have fallen back to a host kernel on a failed probe;
-        # the batched-window path must honor that too or it would hang on
-        # the same wedged backend init the probe just detected
+    fn = _resolve(abpt)  # also validates the backend name + breaker state
+    name = last_resolved(abpt.device)
+    if len(windows) > 1 and name in ("jax", "pallas"):
+        # _resolve may have fallen back to a host kernel on a failed probe
+        # or an open breaker; the batched-window path must honor that too
+        # or it would hang on the same wedged backend init the probe just
+        # detected
         from ..utils.probe import apply_platform_pin, jax_backend_reachable
         if jax_backend_reachable():
             apply_platform_pin()
             from .jax_backend import align_windows_jax
-            return align_windows_jax(g, abpt, windows)
+            from .. import resilience as rz
+            if not rz.enabled():
+                return align_windows_jax(g, abpt, windows)
+            from ..obs import count, record_fault
+            try:
+                outs = rz.guarded_device_call(
+                    "dp:windows", name,
+                    lambda: align_windows_jax(g, abpt, windows))
+            except rz.DispatchFailed:
+                count("fallback.windows_host_rerun")
+                return [_host_rerun(g, abpt, b, e, q, exclude=name)
+                        for b, e, q in windows]
+            # same per-result guard contract as the single-dispatch path:
+            # a garbage window re-runs alone on the host, the rest keep
+            # their device results
+            checked = []
+            for (b, e, q), res in zip(windows, outs):
+                res = rz.inject.corrupt_result(res)
+                viol = rz.guards.align_result_violation(
+                    res, len(q), g.node_n, abpt)
+                if viol is not None:
+                    count("guard.dp_violation")
+                    record_fault("garbage_output", backend=name,
+                                 detail=viol, action="host_rerun")
+                    rz.breaker().record_failure(name, "garbage_output")
+                    res = _host_rerun(g, abpt, b, e, q, exclude=name)
+                checked.append(res)
+            return checked
     from ..obs import trace
-    with trace.span("dp:" + last_resolved(abpt.device), "dp",
+    with trace.span("dp:" + name, "dp",
                     args={"rows": g.node_n, "windows": len(windows)}):
-        return [fn(g, abpt, b, e, q) for b, e, q in windows]
+        return [_dispatch_resilient(fn, name, g, abpt, b, e, q)
+                for b, e, q in windows]
 
 
 def align_sequence_to_graph(g: POAGraph, abpt: Params, query: np.ndarray) -> AlignResult:

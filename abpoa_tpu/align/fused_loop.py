@@ -1528,6 +1528,23 @@ def partition_by_length_bucket(entries):
     return [parts[k] for k in sorted(parts)]
 
 
+def plan_dispatch_footprint(abpt: Params, seq_sets) -> dict:
+    """The compile-ladder rung a fused/lockstep dispatch over `seq_sets`
+    (list of lists of encoded reads) would start from — the memory-
+    admission model's input (resilience/memory.py). Pure host math through
+    the SAME planner functions the drivers call, so the admission estimate
+    and the dispatch can never disagree about the shapes."""
+    qmax = max((len(s) for ss in seq_sets for s in ss), default=1)
+    Qp, W, _local = _plan_buckets(abpt, qmax)
+    R = reads_rung(max((len(ss) for ss in seq_sets), default=1))
+    K = len(seq_sets)
+    Kb = k_rung(K) if K > 1 else 1
+    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    plane16 = max_score_bound(abpt, qmax, 2) <= int16_score_limit(abpt)
+    return dict(N=N, E=8, A=8, W=W, Qp=Qp, reads=R, K=Kb,
+                plane16=plane16, gap_mode=abpt.gap_mode, m=abpt.m)
+
+
 def _pad_read_set(seqs, weights, Qp: int, mat: np.ndarray, m: int,
                   n_rows: int = None):
     """-> (seqs_pad, wgts_pad, lens, qp) host arrays for one read set.
@@ -1989,6 +2006,7 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
             _record_fused_dp(abpt, int(n_reads_v[k]), qmax,
                              int(node_ns[k]), W, Qp)
     out = []
+    from ..resilience.guards import GarbageOutput
     for k in range(K):
         if failed[k]:
             out.append(None)
@@ -1997,7 +2015,20 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
         if record_paths and int(host.collisions[k]) > 0:
             out.append(None)  # read-id replay unavailable for this set
             continue
-        pg = _download_graph(st_k, abpt)
+        try:
+            pg = _download_graph(st_k, abpt)
+        except GarbageOutput as e:
+            # per-set isolation: one set's garbage output re-runs that set
+            # on the caller's sequential path; the rest keep their results
+            from ..obs import record_fault
+            record_fault("garbage_output", backend=abpt.device, set_index=k,
+                         detail=str(e)[:300], action="sequential_rerun")
+            from ..resilience.breaker import breaker
+            breaker().record_failure(
+                "jax" if abpt.device == "tpu" else abpt.device,
+                "garbage_output")
+            out.append(None)
+            continue
         if record_paths:
             _replay_read_ids(pg, st_k, int(n_reads_v[k]))
         n_k = int(n_reads_v[k])
@@ -2142,6 +2173,14 @@ def _download_graph(state: FusedState, abpt: Params):
             g.base[:n], g.in_ids[:n], g.in_w[:n], g.in_cnt[:n],
             g.out_ids[:n], g.out_w[:n], g.out_cnt[:n],
             g.aligned[:n], g.aligned_cnt[:n], g.n_read[:n], g.n_span[:n])]
+    # output sanity guard on the already-downloaded host array (no extra
+    # sync): a mis-DMA'd kernel output must fail loudly here, not become a
+    # wrong consensus. The garbage injector corrupts exactly this array.
+    from .. import resilience as rz
+    if rz.enabled():
+        base = base.copy() if rz.inject.armed("garbage") else base
+        rz.inject.corrupt_graph_base(base)
+        rz.guards.check_graph_bases(base[2:], abpt.m)  # skip src/sink
     pg = POAGraph()
     pg.nodes = []
     for i in range(n):

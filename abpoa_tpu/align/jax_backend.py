@@ -534,7 +534,7 @@ def _window_mesh_size(B: int) -> int:
     window batch; 1 disables sharding (single chip / single window)."""
     try:
         n_avail = len(jax.devices())
-    except Exception:
+    except RuntimeError:  # backend init failed: single-slot fallback
         return 1
     n = 1
     while n * 2 <= min(n_avail, B):

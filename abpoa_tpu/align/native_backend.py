@@ -57,6 +57,7 @@ def align_sequence_to_subgraph_native(g, abpt: Params, beg_node_id: int,
     res.best_score = int(meta[0])
     n_c = int(meta[7])
     res.cigar = [int(x) for x in cig[:n_c]]
+    res.cigar_arr = cig[:n_c]  # guards validate the array, no re-convert
     if abpt.rev_cigar:
         res.cigar.reverse()
     res.node_s, res.node_e = int(meta[1]), int(meta[2])

@@ -15,3 +15,8 @@ class AlignResult:
     n_aln_bases: int = 0
     n_matched_bases: int = 0
     best_score: int = 0
+    # optional uint64 ndarray view of `cigar`, attached by backends that
+    # already hold one (native): the output guards validate the array
+    # instead of re-converting the Python list (~300 us per 2 kb read —
+    # 10% of warm sim2k wall, resilience overhead guard)
+    cigar_arr: object = field(default=None, repr=False, compare=False)

@@ -55,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="DP backend: auto | numpy | native | jax | pallas "
                         "[auto: accelerator if reachable, else native C++, "
                         "else numpy]")
+    p.add_argument("--lockstep", type=str, default="auto",
+                   choices=["auto", "on", "off"],
+                   help="vmapped lockstep batching for -l multi-set runs: "
+                        "auto = only on a real accelerator mesh (serial "
+                        "K=1 is faster on CPU, see ROUND8_NOTES.md); "
+                        "on/off force it [%(default)s]")
     p.add_argument("--report", type=str, default=None, metavar="FILE",
                    help="write a structured JSON run report (versioned "
                         "schema: phase wall-times, dispatch/fallback/"
@@ -130,6 +136,7 @@ def args_to_params(args: argparse.Namespace) -> Params:
     abpt.min_freq = args.min_freq
     abpt.verbose = args.verbose
     abpt.device = args.device
+    abpt.lockstep = args.lockstep
     return abpt
 
 
@@ -234,17 +241,36 @@ def main(argv=None) -> int:
     t0 = time.time()
     c0 = time.process_time()
     ab = Abpoa()
+    rc = 0
+    from .resilience import QUARANTINE_EXCEPTIONS
     try:
         if args.in_list:
             with open(args.input) as lf:
                 files = [ln.strip() for ln in lf if ln.strip()]
             # run_batch lockstep-batches fused-eligible sets into one
             # vmapped device dispatch per group (reference -l loop,
-            # src/abpoa.c:148-168, sequential there)
+            # src/abpoa.c:148-168, sequential there). Poisoned sets are
+            # quarantined per set (structured stderr line + `faults`
+            # record); the run exits 0 while any healthy set completed.
             from .parallel import run_batch
-            run_batch(files, abpt, out_fp)
+            stats = run_batch(files, abpt, out_fp)
+            if stats["quarantined"]:
+                print(f"[abpoa_tpu::main] {stats['quarantined']} of "
+                      f"{stats['sets']} read sets quarantined "
+                      "(see warnings above / --report faults)",
+                      file=sys.stderr)
+                if stats["quarantined"] >= stats["sets"]:
+                    rc = 1  # nothing succeeded: that IS a failed run
         else:
-            msa_from_file(ab, abpt, args.input, out_fp)
+            try:
+                msa_from_file(ab, abpt, args.input, out_fp)
+            except QUARANTINE_EXCEPTIONS as e:
+                # single-set run: the same malformed-input/I/O-decay
+                # classes the -l boundary quarantines become a structured
+                # one-line error here (rc=1), never a traceback
+                print(f"Error: {args.input}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                rc = 1
     finally:
         if out_fp is not sys.stdout:
             out_fp.close()
@@ -266,7 +292,7 @@ def main(argv=None) -> int:
         # (tests, library use) doesn't keep paying span overhead into a
         # stale ring after this run's export
         obs.trace_disable()
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -28,13 +28,19 @@ def _open(path: str):
 
 
 def iter_fastx(path: str) -> Iterator[SeqRecord]:
+    """Hardened against the malformed inputs the quarantine fuzz grid
+    feeds it (tests/test_resilience.py): CRLF line endings are stripped
+    everywhere (a '\\r' left in a sequence would silently encode as an
+    ambiguous base), and a FASTQ record truncated at EOF yields its
+    partial fields as-is — `resilience.validate_records` then rejects the
+    set with a structured per-set error instead of a wrong consensus."""
     with _open(path) as fp:
         name = comment = None
         seq_parts: List[str] = []
         qual_parts: List[str] = []
         in_qual = False
         for line in fp:
-            line = line.rstrip("\n")
+            line = line.rstrip("\r\n")
             if not line and not in_qual:
                 continue
             if line.startswith(">") or (line.startswith("@") and not in_qual and name is None):
@@ -46,10 +52,12 @@ def iter_fastx(path: str) -> Iterator[SeqRecord]:
                 seq_parts, qual_parts, in_qual = [], [], False
                 is_fq = line.startswith("@")
                 if is_fq:
-                    # FASTQ: strict 4-line records
-                    seq = fp.readline().rstrip("\n")
+                    # FASTQ: strict 4-line records (readline() returns ""
+                    # past EOF, so a truncated record yields short fields
+                    # for validation to reject — never an exception here)
+                    seq = fp.readline().rstrip("\r\n")
                     fp.readline()  # '+'
-                    qual = fp.readline().rstrip("\n")
+                    qual = fp.readline().rstrip("\r\n")
                     yield SeqRecord(name, comment or "", seq, qual)
                     name = None
             else:

@@ -10,8 +10,9 @@ from . import trace
 from .capture import device_capture, profile_dir, set_profile_dir
 from .compile_log import compile_watch
 from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
-                     finalize_report, observe, phase, record_dp, record_read,
-                     report, set_enabled, start_run, summary, write_report)
+                     finalize_report, observe, phase, record_dp, record_fault,
+                     record_read, report, set_enabled, start_run, summary,
+                     write_report)
 from .trace import (export_chrome_trace, instant, span, span_totals, tracer)
 from .trace import disable as trace_disable
 from .trace import enable as trace_enable
@@ -19,7 +20,8 @@ from .trace import enabled as trace_enabled
 
 __all__ = [
     "SCHEMA", "SCHEMA_KEYS", "SCHEMA_VERSION", "RunReport",
-    "count", "observe", "phase", "record_dp", "record_read", "report",
+    "count", "observe", "phase", "record_dp", "record_fault", "record_read",
+    "report",
     "start_run", "set_enabled", "finalize_report", "write_report", "summary",
     "device_capture", "profile_dir", "set_profile_dir",
     "trace", "trace_enable", "trace_disable", "trace_enabled",

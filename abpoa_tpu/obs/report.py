@@ -26,26 +26,34 @@ from . import compile_log as _clog
 from . import trace as _trace
 
 SCHEMA = "abpoa-tpu-run-report"
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # top-level keys of the rendered report, in schema order. Goldened by
 # tests/test_obs.py: adding a key is a SCHEMA_VERSION bump.
 # v2 adds `reads` (per-read latency records -> p50/p95/p99, the item-1
 # service's SLO numbers) and `compiles` (the compile log, compile_log.py).
+# v3 adds `faults` (every absorbed dispatch failure / quarantined set,
+# abpoa_tpu/resilience) and `degraded` (circuit-breaker demotions active
+# at the end of the run) — a clean run carries null for both.
 SCHEMA_KEYS = ("schema", "schema_version", "created", "total_wall_s",
                "phase_wall_sum_s", "phases", "counters", "values",
-               "reads", "compiles", "device", "mfu")
+               "reads", "compiles", "faults", "degraded", "device", "mfu")
 
 # per-read record bound: percentiles over a truncated stream would lie,
 # so past the cap records are dropped AND counted (`reads.dropped`)
 READS_CAP = 100_000
+
+# fault-record bound (same contract as READS_CAP): a fault storm must not
+# grow the report without bound, but the drops are counted
+FAULTS_CAP = 256
 
 
 class RunReport:
     """Phase timers + counters + value summaries for one run."""
 
     __slots__ = ("enabled", "t_start", "phases", "counters", "values",
-                 "reads", "reads_dropped")
+                 "reads", "reads_dropped", "faults", "faults_dropped",
+                 "degraded")
 
     def __init__(self) -> None:
         self.enabled = True
@@ -59,6 +67,11 @@ class RunReport:
         # (wall_s, qlen, band_cols, backend, fallback, amortized)
         self.reads: list = []
         self.reads_dropped = 0
+        # absorbed failures (resilience layer): dicts, FAULTS_CAP-bounded
+        self.faults: list = []
+        self.faults_dropped = 0
+        # backend -> {"to", "reason", "failures"} (circuit-breaker opens)
+        self.degraded: Dict[str, dict] = {}
         _clog.reset_run()
 
     @contextlib.contextmanager
@@ -141,7 +154,54 @@ class RunReport:
         else:
             self.reads_dropped += 1
 
+    def record_fault(self, kind: str, backend: Optional[str] = None,
+                     set_index: Optional[int] = None, detail: str = "",
+                     action: str = "") -> None:
+        """One absorbed failure (abpoa_tpu/resilience): what failed, where
+        it was headed, and what the degradation ladder did about it. The
+        contract of that layer is that NOTHING is swallowed silently —
+        every fallback/demotion/quarantine lands here (and in the
+        `faults.<kind>` counter) even when the run then succeeds."""
+        if not self.enabled:
+            return
+        self.count(f"faults.{kind}")
+        if len(self.faults) >= FAULTS_CAP:
+            self.faults_dropped += 1
+            return
+        rec = {"kind": kind, "t_s": round(time.perf_counter() - self.t_start,
+                                          4)}
+        if backend:
+            rec["backend"] = backend
+        if set_index is not None:
+            rec["set"] = set_index
+        if detail:
+            rec["detail"] = detail
+        if action:
+            rec["action"] = action
+        self.faults.append(rec)
+
+    def mark_degraded(self, backend: str, to: str, reason: str,
+                      failures: int) -> None:
+        """A circuit-breaker open: `backend` serves as `to` for the rest
+        of the run (resilience/breaker.py is the single caller)."""
+        if self.enabled:
+            self.degraded[backend] = {"to": to, "reason": reason,
+                                      "failures": failures}
+
     # ----------------------------------------------------------- rendering
+    def _faults_block(self) -> Optional[dict]:
+        if not self.faults and not self.faults_dropped:
+            return None
+        kinds: Dict[str, int] = {}
+        for rec in self.faults:
+            kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
+        return {
+            "count": len(self.faults) + self.faults_dropped,
+            "dropped": self.faults_dropped,
+            "kinds": dict(sorted(kinds.items())),
+            "records": self.faults,
+        }
+
     def _reads_block(self) -> Optional[dict]:
         """Tail-latency aggregation of the per-read records: nearest-rank
         p50/p95/p99 over wall, plus backend/fallback attribution."""
@@ -222,6 +282,8 @@ class RunReport:
             "values": values,
             "reads": self._reads_block(),
             "compiles": self._compiles_block(),
+            "faults": self._faults_block(),
+            "degraded": dict(sorted(self.degraded.items())) or None,
             "device": dev,
             "mfu": mfu_block(self, dev),
         }
@@ -272,6 +334,14 @@ def start_run() -> None:
     try:
         from ..align.dispatch import _LAST_RESOLVED
         _LAST_RESOLVED["name"] = ""
+        _LAST_RESOLVED["reason"] = None
+    except Exception:
+        pass
+    # circuit-breaker demotions are run-scoped ("for the remainder of the
+    # run"): a fresh run gets the requested backend back
+    try:
+        from ..resilience.breaker import breaker
+        breaker().reset()
     except Exception:
         pass
 
@@ -301,6 +371,12 @@ def record_read(wall_s: float, qlen: int, band_cols: int, backend: str,
                 fallback: Optional[str] = None,
                 amortized: bool = False) -> None:
     _REPORT.record_read(wall_s, qlen, band_cols, backend, fallback, amortized)
+
+
+def record_fault(kind: str, backend: Optional[str] = None,
+                 set_index: Optional[int] = None, detail: str = "",
+                 action: str = "") -> None:
+    _REPORT.record_fault(kind, backend, set_index, detail, action)
 
 
 def finalize_report() -> dict:
@@ -407,6 +483,41 @@ def render_report(rep: dict) -> str:
                      f"{comp['hits']} cache hits"
                      + (f", {comp['xla_compile_s']:.3f}s in XLA"
                         if comp.get("xla_compile_s") else ""))
+
+    # v3: fault history + active demotions — the operator's view of what
+    # the degradation ladder absorbed (resilience/), without raw JSON
+    faults = rep.get("faults")
+    if faults:
+        lines.append("")
+        lines.append(f"faults: {faults['count']:,}"
+                     + (f" (+{faults['dropped']:,} dropped)"
+                        if faults.get("dropped") else "")
+                     + "  " + "  ".join(f"{k}={v}" for k, v in
+                                        faults["kinds"].items()))
+        for rec in faults["records"][:20]:
+            where = (f" set {rec['set']}" if "set" in rec
+                     else (f" [{rec['backend']}]" if "backend" in rec
+                           else ""))
+            act = f" -> {rec['action']}" if rec.get("action") else ""
+            det = f": {rec['detail']}" if rec.get("detail") else ""
+            lines.append(f"  t+{rec['t_s']:.2f}s {rec['kind']}{where}"
+                         f"{act}{det}")
+        if len(faults["records"]) > 20:
+            lines.append(f"  ... {len(faults['records']) - 20} more "
+                         "(see the JSON report)")
+    degraded = rep.get("degraded")
+    if degraded:
+        lines.append("")
+        lines.append("degraded (circuit breakers open at end of run):")
+        for backend, d in degraded.items():
+            lines.append(f"  {backend} -> {d['to']}  after {d['failures']} "
+                         f"failures (last: {d['reason']})")
+    quarantined = ((rep.get("counters") or {}).get("quarantine.sets")
+                   or (faults or {}).get("kinds", {}).get("poisoned_set"))
+    if quarantined:
+        lines.append("")
+        lines.append(f"quarantined sets: {quarantined} "
+                     "(see faults records with a set index)")
 
     counters = rep.get("counters") or {}
     if counters:

@@ -1,1 +1,1 @@
-from .runner import run_batch, shard_dp_batch
+from .runner import lockstep_enabled, run_batch, shard_dp_batch

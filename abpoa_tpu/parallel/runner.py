@@ -41,11 +41,37 @@ def lockstep_group_size() -> int:
     return max(1, int(os.environ.get("ABPOA_TPU_LOCKSTEP_K", "8")))
 
 
+def lockstep_enabled(abpt: Params) -> bool:
+    """Should `-l`/batch runs vmap K sets into one lockstep dispatch?
+
+    On CPU-only hosts the answer is NO by default: the round-8 measurement
+    (ROUND8_NOTES.md, BENCH_lockstep_cpu.json) showed K=4 lockstep 1.37x
+    SLOWER than the serial K=1 path on the 8-way CPU mesh — vmapped masked
+    scatters serialize on XLA:CPU, so batching independent sets loses a
+    third of the machine. Lockstep therefore defaults on only when a real
+    accelerator mesh is attached, and stays available as an explicit
+    opt-in (`--lockstep on` / ABPOA_TPU_LOCKSTEP=1) for measurement.
+    """
+    mode = getattr(abpt, "lockstep", "auto")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    env = os.environ.get("ABPOA_TPU_LOCKSTEP", "").lower()
+    if env in ("1", "on", "true"):
+        return True
+    if env in ("0", "off", "false"):
+        return False
+    from ..utils.probe import has_accelerator
+    return has_accelerator()
+
+
 def _lockstep_ok(abpt: Params) -> bool:
     from ..pipeline import plain_route
     from ..align.eligibility import fused_config_eligible
     return (abpt.device in ("jax", "tpu", "pallas")
             and not abpt.incr_fn
+            and lockstep_enabled(abpt)
             and plain_route(abpt)
             and fused_config_eligible(abpt))
 
@@ -71,37 +97,53 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
     # alone — completed buckets keep their device results. The outer
     # device_capture makes the whole group ONE XProf capture (the inner
     # per-sub-batch brackets degrade to trace annotations inside it).
+    from .. import resilience as rz
+    backend = "jax" if abpt.device == "tpu" else abpt.device
     with trace.span("lockstep_group", "fused",
                     args={"k": len(group), "group": gi}), \
             device_capture("lockstep_group"):
         for sub in partition_by_length_bucket(
                 [(e[0], e[2], e[3], e[1]) for e in group]):
-            flat.extend(sub)
-            t0 = time.perf_counter()
-            try:
-                with jax.default_device(dev):
-                    from ..obs import phase
-                    with phase("align_fused"):
-                        outs.extend(progressive_poa_fused_batch(
-                            [e[1] for e in sub], [e[2] for e in sub], abpt))
-            except RuntimeError as e:
-                print(f"Warning: fused lockstep batch failed ({e}); "
-                      "falling back to sequential processing.",
-                      file=sys.stderr)
-                count("fallback.lockstep_to_sequential")
-                outs.extend([None] * len(sub))
-                continue
-            # amortized per-read SLO records (same contract as
-            # pyapi.msa_batch): the sub-batch wall split evenly across
-            # every read it carried
-            from ..obs import record_read
-            from ..pipeline import _band_cols
-            n_sub = sum(len(e[1]) for e in sub)
-            share = (time.perf_counter() - t0) / max(1, n_sub)
-            for e in sub:
-                for b in e[1]:
-                    record_read(share, len(b), _band_cols(abpt, len(b)),
-                                abpt.device, amortized=True)
+            # memory admission from the compile-ladder rung: an over-budget
+            # group dispatches in smaller K pieces; sets too large for even
+            # a K=1 dispatch demote to the sequential path (counted +
+            # reported by admission_plan)
+            pieces = (rz.memory.admission_plan(abpt, sub, lambda e: e[1])
+                      if rz.enabled() else [(list(sub), "dispatch")])
+            for piece, action in pieces:
+                flat.extend(piece)
+                if action == "demote":
+                    count("fallback.admission_demote", len(piece))
+                    outs.extend([None] * len(piece))
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    with jax.default_device(dev):
+                        from ..obs import phase
+                        with phase("align_fused"):
+                            outs.extend(rz.guarded_device_call(
+                                "lockstep_batch", backend,
+                                lambda p=piece: progressive_poa_fused_batch(
+                                    [e[1] for e in p], [e[2] for e in p],
+                                    abpt)))
+                except (rz.DispatchFailed, RuntimeError) as e:
+                    print(f"Warning: fused lockstep batch failed ({e}); "
+                          "falling back to sequential processing.",
+                          file=sys.stderr)
+                    count("fallback.lockstep_to_sequential")
+                    outs.extend([None] * len(piece))
+                    continue
+                # amortized per-read SLO records (same contract as
+                # pyapi.msa_batch): the sub-batch wall split evenly across
+                # every read it carried
+                from ..obs import record_read
+                from ..pipeline import _band_cols
+                n_sub = sum(len(e[1]) for e in piece)
+                share = (time.perf_counter() - t0) / max(1, n_sub)
+                for e in piece:
+                    for b in e[1]:
+                        record_read(share, len(b), _band_cols(abpt, len(b)),
+                                    abpt.device, amortized=True)
     for (idx, _seqs, _w, ab), res in zip(flat, outs):
         if res is None:
             continue
@@ -119,18 +161,26 @@ def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
 
 
 def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
-              devices: List = None) -> None:
+              devices: List = None) -> dict:
     """Process independent read-set files (the `-l` mode): lockstep-batched
-    on device when eligible, sequential round-robin otherwise. Output order
-    and bytes match sequential processing exactly.
+    on device when eligible (a real accelerator mesh, or explicit opt-in —
+    see `lockstep_enabled`), sequential round-robin otherwise. Output
+    order and bytes match sequential processing exactly.
+
+    Per-set quarantine: a file that fails to parse/validate produces a
+    structured per-set error (a `faults` record + one stderr line) and the
+    remaining sets complete — one poisoned set never drops the batch.
+    Returns {"sets", "quarantined"} so the CLI can pick its exit status.
 
     Lockstep processing streams SEGMENT by segment (a segment ends when K
     eligible sets have accumulated): each segment is computed as one
     vmapped dispatch, then emitted in file order, so peak memory is one
     group's read sets + graphs — not the whole file list."""
+    from .. import resilience as rz
     from ..pipeline import Abpoa, msa_from_file, output
+    stats = {"sets": len(files), "quarantined": 0}
     if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
-        return  # mirror msa_from_file: nothing to emit, nothing to compute
+        return stats  # mirror msa_from_file: nothing to emit or compute
     lock = _lockstep_ok(abpt)
     if devices is None:
         if lock or abpt.device in ("jax", "tpu", "pallas"):
@@ -165,11 +215,21 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
                 with jax.default_device(dev):
                     msa_from_file(ab, abpt, fn, out_fp)
 
+    def run_one_quarantined(ab, i, fn):
+        """Sequential per-file run with the per-set quarantine boundary:
+        malformed input / I/O decay isolates THIS set; real bugs still
+        propagate (rz.QUARANTINE_EXCEPTIONS is the closed list)."""
+        try:
+            run_one(ab, i, fn)
+        except rz.QUARANTINE_EXCEPTIONS as e:
+            rz.quarantine_set(i, fn, e)
+            stats["quarantined"] += 1
+
     if not lock:
         ab = Abpoa()
         for i, fn in enumerate(files):
-            run_one(ab, i, fn)
-        return
+            run_one_quarantined(ab, i, fn)
+        return stats
 
     from ..align.eligibility import fused_eligible
     from ..io.fastx import read_fastx
@@ -191,24 +251,28 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
             else:
                 # ineligible or device-failed: sequential path (re-reads the
                 # file; IO is negligible next to alignment)
-                run_one(ab_seq, idx, fn)
+                run_one_quarantined(ab_seq, idx, fn)
         seg.clear()
         group.clear()
 
     for i, fn in enumerate(files):
         try:
             records = read_fastx(fn)
+            rz.validate_records(records, abpt, label=fn)
             ab = Abpoa()
             seqs, weights = _ingest_records(ab, abpt, records)
-        except Exception:
-            emit_segment()  # files before the bad one still emit, in order
-            raise
+        except rz.QUARANTINE_EXCEPTIONS as e:
+            # per-set quarantine: report this set, keep the batch going
+            rz.quarantine_set(i, fn, e)
+            stats["quarantined"] += 1
+            continue
         seg.append((i, fn))
         if fused_eligible(abpt, len(seqs)):
             group.append((i, ab, seqs, weights))
         if len(group) == K:
             emit_segment()
     emit_segment()
+    return stats
 
 
 def shard_dp_batch(mesh_devices: int = None):

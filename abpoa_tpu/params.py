@@ -114,6 +114,12 @@ class Params:
     # "native", "jax", "pallas" pin an engine
     device: str = "auto"
 
+    # lockstep multi-set batching policy for `-l`/msa_batch: "auto" vmaps
+    # K sets only when a real accelerator mesh is attached (serial K=1 is
+    # faster on CPU — ROUND8_NOTES.md / BENCH_lockstep_cpu.json); "on"/
+    # "off" force it (see parallel.lockstep_enabled, CLI --lockstep)
+    lockstep: str = "auto"
+
     # derived (set by finalize)
     mat: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
     max_mat: int = 0

@@ -129,10 +129,27 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
         obs.count("fallback.jax_probe_timeout")
         return False
     apply_platform_pin()
+    from . import resilience as rz
+    backend = "jax" if abpt.device == "tpu" else abpt.device
+    if rz.enabled() and rz.breaker().is_open(backend):
+        # the breaker already demoted this backend for the run: go
+        # straight to the host loop instead of re-failing the dispatch
+        obs.count("fallback.fused_breaker_open")
+        return False
     from .align.eligibility import fused_eligible
     if not fused_eligible(abpt, len(seqs)):
         return False
-    from .align.fused_loop import progressive_poa_fused
+    from .align.fused_loop import plan_dispatch_footprint, progressive_poa_fused
+    if rz.enabled():
+        # memory admission: a set whose planes exceed the device budget is
+        # demoted to the host loop up front instead of OOMing mid-run
+        decision, est, budget = rz.memory.admit(
+            plan_dispatch_footprint(abpt, [seqs]))
+        if decision != "ok":
+            obs.record_fault("admission", backend=backend,
+                             detail=f"estimated {est} B > budget {budget} B",
+                             action="demote_host")
+            return False
     init_graph = None
     if exist_n_seq:
         # incremental `-i`: extend the restored graph on device; read-id
@@ -148,9 +165,13 @@ def _run_fused_device(ab: Abpoa, abpt: Params, seqs, weights,
     t0 = time.perf_counter()
     try:
         with obs.phase("align_fused"):
-            pg, _, is_rc = progressive_poa_fused(seqs, weights, abpt,
-                                                 init_graph=init_graph)
-    except RuntimeError as e:
+            # the resilience envelope: injection points, watchdog deadline,
+            # classified fault records + circuit breaker, bounded retry
+            pg, _, is_rc = rz.guarded_device_call(
+                "fused_loop", backend,
+                lambda: progressive_poa_fused(seqs, weights, abpt,
+                                              init_graph=init_graph))
+    except (rz.DispatchFailed, RuntimeError) as e:
         print(f"Warning: fused device loop failed ({e}); "
               "falling back to the per-read loop.", file=sys.stderr)
         obs.count("fallback.fused_to_host")
@@ -252,7 +273,9 @@ def _reroute_device_ineligible(abpt: Params) -> Optional[str]:
     try:
         from .native import load
         host = "native" if load() is not None else "numpy"
-    except Exception:
+    except (ImportError, OSError, RuntimeError) as e:
+        obs.record_fault("backend_init", backend="native",
+                         detail=str(e)[:200], action="numpy")
         host = "numpy"
     if not _REROUTE_WARNED:
         print(f"Warning: {reason} is outside the fused device loop; "
@@ -268,6 +291,12 @@ def _reroute_device_ineligible(abpt: Params) -> Optional[str]:
 def msa(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
     """File-level driver (reference abpoa_msa1)."""
     assert abpt._finalized, "call Params.finalize() first"
+    # malformed-input hardening: a poisoned set raises a structured
+    # PoisonedSetError here (quarantined by `-l` / batch callers, a
+    # one-line error + rc=1 from the single-file CLI) — never a traceback
+    # out of the alignment core, never a partial silent result
+    from .resilience import validate_records
+    validate_records(records, abpt)
     orig_device = _reroute_device_ineligible(abpt)
     try:
         _msa_inner(ab, abpt, records, out_fp)
@@ -285,8 +314,12 @@ def _msa_inner(ab: Abpoa, abpt: Params, records, out_fp: IO[str]) -> None:
             try:
                 from .native.graph import NativePOAGraph
                 ab.graph = NativePOAGraph()
-            except Exception:
-                pass
+            except (ImportError, OSError, RuntimeError) as e:
+                # no native build: the Python graph engine serves — counted
+                # so a broken .so can't silently eat the fast path
+                obs.count("fallback.native_graph_unavailable")
+                obs.record_fault("backend_init", backend="native",
+                                 detail=str(e)[:200], action="python_graph")
         elif not _want_native(abpt) and getattr(ab.graph, "is_native", False):
             ab.graph = POAGraph()
         ab.reset()
@@ -322,6 +355,17 @@ def _native_cons_fast_path(ab: Abpoa, abpt: Params, out_fp: IO[str]) -> bool:
         return False
     with obs.phase("consensus"):
         abc = native_consensus_hb(g, ab.n_seq)
+    from .resilience import enabled as rz_enabled
+    from .resilience.guards import consensus_violation
+    if rz_enabled():
+        viol = consensus_violation(abc, abpt.m)
+        if viol is not None:
+            # one-shot re-run on the Python consensus walk (the reference
+            # semantics) instead of emitting out-of-alphabet bases
+            obs.count("guard.consensus_violation")
+            obs.record_fault("garbage_output", backend="native",
+                             detail=viol, action="python_consensus")
+            return False
     if abc.n_cons == 0:
         print("Warning: no consensus sequence generated.", file=sys.stderr)
     ab.cons = abc

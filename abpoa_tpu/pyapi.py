@@ -55,7 +55,7 @@ class msa_aligner:
     def __init__(self, aln_mode="g", is_aa=False, match=2, mismatch=4,
                  score_matrix="", gap_open1=4, gap_open2=24, gap_ext1=2,
                  gap_ext2=1, extra_b=10, extra_f=0.01, cons_algrm="HB",
-                 device="numpy"):
+                 device="numpy", lockstep="auto"):
         abpt = Params()
         modes = {"g": C.GLOBAL_MODE, "l": C.LOCAL_MODE, "e": C.EXTEND_MODE}
         if aln_mode not in modes:
@@ -81,6 +81,10 @@ class msa_aligner:
         else:
             raise ValueError(f"Unknown consensus algorithm: {cons_algrm}")
         abpt.device = device
+        # msa_batch lockstep policy: "auto" vmaps K sets only on a real
+        # accelerator mesh (serial is faster on CPU, ROUND8_NOTES.md);
+        # "on"/"off" force it (parallel.lockstep_enabled)
+        abpt.lockstep = lockstep
         self.abpt = abpt
         self.ab = Abpoa()
         self._last_report = None
@@ -99,7 +103,11 @@ class msa_aligner:
         g = self.ab.graph
         if qscores is not None and len(qscores) != len(seqs):
             raise ValueError("qscores must contain one entry per input sequence.")
+        from .resilience import PoisonedSetError
         for read_i, seq in enumerate(seqs):
+            if not seq:
+                raise PoisonedSetError(
+                    f"sequence {read_i} is empty")
             bseq = enc[np.frombuffer(seq.encode(), dtype=np.uint8)].astype(np.uint8)
             weights = None
             if qscores is not None:
@@ -237,21 +245,31 @@ class msa_aligner:
         abpt.use_qv = qscores_sets is not None
         abpt.incr_fn = None
         abpt.finalize()
+        from . import resilience as rz
         from .align.eligibility import fused_eligible
 
         def seq_fallback(k):
             qs = qscores_sets[k] if qscores_sets is not None else None
-            return self.msa(seq_sets[k], out_cons, out_msa, max_n_cons,
-                            min_freq, qscores=qs)
+            # per-set quarantine: one poisoned set (malformed record,
+            # empty sequence) returns None in its slot — reported as a
+            # `faults` record with the set index — and the rest complete
+            try:
+                return self.msa(seq_sets[k], out_cons, out_msa, max_n_cons,
+                                min_freq, qscores=qs)
+            except rz.QUARANTINE_EXCEPTIONS as e:
+                rz.quarantine_set(k, f"set {k}", e)
+                return None
 
         results: List[msa_result] = [None] * len(seq_sets)
         lockstep: List[int] = []
         enc_sets, wgt_sets = [], []
         eligible = abpt.device in ("jax", "tpu", "pallas")
         if eligible:
+            from .parallel import lockstep_enabled
             from .pipeline import plain_route
             from .utils.probe import jax_backend_reachable
-            eligible = plain_route(abpt) and jax_backend_reachable()
+            eligible = (lockstep_enabled(abpt) and plain_route(abpt)
+                        and jax_backend_reachable())
             if eligible:
                 from .utils.probe import apply_platform_pin
                 apply_platform_pin()
@@ -259,6 +277,8 @@ class msa_aligner:
         for k, seqs in enumerate(seq_sets):
             if not (eligible and fused_eligible(abpt, len(seqs))):
                 continue
+            if any(len(s) == 0 for s in seqs):
+                continue  # poisoned: let seq_fallback quarantine it
             if (qscores_sets is not None
                     and len(qscores_sets[k]) != len(seqs)):
                 raise ValueError(
@@ -295,27 +315,43 @@ class msa_aligner:
                                 args={"sets": len(lockstep)}), \
                     obs.device_capture("msa_batch"):
                 from .pipeline import _band_cols
+                backend = "jax" if abpt.device == "tpu" else abpt.device
                 for sub in partition_by_length_bucket(
                         list(zip(lockstep, enc_sets, wgt_sets))):
-                    order.extend(e[0] for e in sub)
-                    t0 = time.perf_counter()
-                    try:
-                        with obs.phase("align_fused"):
-                            outs.extend(progressive_poa_fused_batch(
-                                [e[1] for e in sub], [e[2] for e in sub],
-                                abpt))
-                    except RuntimeError:
-                        outs.extend([None] * len(sub))
-                        continue
-                    # amortized per-read SLO records: the sub-batch wall
-                    # split evenly across every read it carried
-                    n_sub = sum(len(e[1]) for e in sub)
-                    share = (time.perf_counter() - t0) / max(1, n_sub)
-                    for e in sub:
-                        for b in e[1]:
-                            obs.record_read(share, len(b),
-                                            _band_cols(abpt, len(b)),
-                                            abpt.device, amortized=True)
+                    # memory admission (resilience/memory.py): over-budget
+                    # groups dispatch in smaller K pieces; sets too big for
+                    # K=1 demote to the sequential fallback
+                    pieces = (rz.memory.admission_plan(abpt, sub,
+                                                       lambda e: e[1])
+                              if rz.enabled() else [(list(sub), "dispatch")])
+                    for piece, action in pieces:
+                        order.extend(e[0] for e in piece)
+                        if action == "demote":
+                            obs.count("fallback.admission_demote",
+                                      len(piece))
+                            outs.extend([None] * len(piece))
+                            continue
+                        t0 = time.perf_counter()
+                        try:
+                            with obs.phase("align_fused"):
+                                outs.extend(rz.guarded_device_call(
+                                    "msa_batch", backend,
+                                    lambda p=piece:
+                                    progressive_poa_fused_batch(
+                                        [e[1] for e in p],
+                                        [e[2] for e in p], abpt)))
+                        except (rz.DispatchFailed, RuntimeError):
+                            outs.extend([None] * len(piece))
+                            continue
+                        # amortized per-read SLO records: the sub-batch
+                        # wall split evenly across every read it carried
+                        n_sub = sum(len(e[1]) for e in piece)
+                        share = (time.perf_counter() - t0) / max(1, n_sub)
+                        for e in piece:
+                            for b in e[1]:
+                                obs.record_read(share, len(b),
+                                                _band_cols(abpt, len(b)),
+                                                abpt.device, amortized=True)
             for k, res in zip(order, outs):
                 if res is None:
                     continue

@@ -1,0 +1,190 @@
+"""Resilient dispatch: the fault story of the alignment engine.
+
+A long-lived service (ROADMAP item 1) must survive compile failures,
+device OOMs, kernel hangs, garbage outputs and malformed inputs without
+dropping the batch. This package is the mechanism layer the dispatch
+sites (align/dispatch.py, pipeline._run_fused_device, parallel/runner.py,
+pyapi.msa_batch) wire together:
+
+- inject.py     deterministic fault injectors (ABPOA_TPU_INJECT=...)
+- watchdog.py   wall-clock deadline on device dispatches
+- breaker.py    per-backend circuit breaker + the demotion ladder
+                (pallas -> jax -> native -> numpy)
+- guards.py     output sanity invariants (scores/CIGAR/alphabet)
+- memory.py     admission control from the compile-ladder rung
+- quarantine.py per-set isolation for `-l` / batch runs
+
+`guarded_device_call` below is the common envelope: injection points,
+watchdog, classified fault records, breaker bookkeeping, bounded retry
+with exponential backoff. Every absorbed failure lands in the run
+report's `faults` block (obs schema v3) — nothing is swallowed silently —
+and unclassifiable exceptions (TypeError and friends: real bugs) always
+propagate.
+
+Overhead contract: with injection disarmed, a host-kernel run takes the
+direct-call path — no worker threads, no device syncs, O(|cigar|) guard
+arithmetic per read. tests/test_resilience.py guards warm-run wall like
+the obs overhead guard does. ABPOA_TPU_RESILIENCE=0 (or set_enabled)
+bypasses the envelope entirely for A/B measurement.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+from . import guards, inject, memory, watchdog
+from .breaker import DEMOTION, CircuitBreaker, breaker
+from .guards import GarbageOutput
+from .inject import (InjectedCompileFailure, InjectedDeviceOOM,
+                     InjectedFault, InjectedNativeCrash)
+from .quarantine import (PoisonedSetError, QUARANTINE_EXCEPTIONS,
+                         quarantine_set, validate_records)
+from .watchdog import DispatchTimeout
+
+__all__ = [
+    "guards", "inject", "memory", "watchdog",
+    "DEMOTION", "CircuitBreaker", "breaker",
+    "GarbageOutput", "DispatchTimeout", "DispatchFailed",
+    "InjectedFault", "InjectedCompileFailure", "InjectedDeviceOOM",
+    "InjectedNativeCrash",
+    "PoisonedSetError", "QUARANTINE_EXCEPTIONS", "quarantine_set",
+    "validate_records",
+    "classify", "guarded_device_call", "enabled", "set_enabled",
+]
+
+
+class DispatchFailed(RuntimeError):
+    """All attempts of a guarded dispatch failed; `kind` is the last
+    classified fault. Subclasses RuntimeError so pre-existing fallback
+    paths (`except RuntimeError`) degrade exactly as before."""
+
+    def __init__(self, kind: str, msg: str) -> None:
+        super().__init__(msg)
+        self.kind = kind
+
+
+_ENABLED = os.environ.get("ABPOA_TPU_RESILIENCE", "1") not in ("0", "off")
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> None:
+    """Kill switch (the overhead guard's control arm)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+# (kind, retryable, counts_against_breaker) per failure class. Retry only
+# where a second attempt is cheap and could differ (allocation races,
+# transient compile-service errors); a hang already cost a full watchdog
+# deadline and a guard violation is deterministic.
+def classify(exc: BaseException) -> Optional[Tuple[str, bool, bool]]:
+    """Classify a dispatch exception; None = not a fault shape we absorb
+    (a real bug: let it propagate)."""
+    if isinstance(exc, InjectedFault):
+        return exc.kind, exc.kind in ("compile_fail", "oom"), True
+    if isinstance(exc, DispatchTimeout):
+        return "hang", False, True
+    if isinstance(exc, GarbageOutput):
+        return "garbage_output", False, True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        low = msg.lower()
+        if msg.startswith(("fused loop", "fused lockstep")):
+            # the fused driver's own structural bails (read-id replay
+            # unavailable, growth non-convergence): deterministic host
+            # fallbacks, not backend faults — don't retry, don't demote
+            return "fused_bail", False, False
+        if ("resource_exhausted" in low or "out of memory" in low
+                or "oom" in low):
+            return "oom", True, True
+        if "compil" in low:
+            return "compile_fail", True, True
+        if "native dp kernel failed" in low:
+            return "native_crash", False, True
+        return "dispatch_error", False, True
+    return None
+
+
+def _retries() -> int:
+    return max(0, int(os.environ.get("ABPOA_TPU_DISPATCH_RETRIES", "1")))
+
+
+def _backoff_base_s() -> float:
+    return float(os.environ.get("ABPOA_TPU_BACKOFF_S", "0.05"))
+
+
+def guarded_device_call(label: str, backend: str, fn: Callable,
+                        deadline_s: float = None):
+    """Run one dispatch under the resilience envelope.
+
+    Device backends (jax/tpu/pallas) run inside the watchdog worker with
+    the injection points armed; host backends run inline (they cannot
+    hang) with only the native-crash injector in front. Classified
+    failures are recorded (`faults` + breaker) and retried with
+    exponential backoff while the classification says a retry could help;
+    exhaustion raises DispatchFailed(kind) for the caller's fallback path.
+    """
+    if not _ENABLED:
+        return fn()
+    from ..obs import count
+    br = breaker()
+    if br.is_open(backend):
+        # the demotion is already decided: fail fast to the caller's
+        # fallback path instead of re-paying the first attempt (on a
+        # wedged accelerator that attempt is a full watchdog deadline
+        # per dispatch — hours over a long `-l` run)
+        count("breaker.short_circuit")
+        raise DispatchFailed(
+            "breaker_open",
+            f"{label}: circuit breaker open for '{backend}' "
+            f"(serving as '{br.effective(backend)}')")
+    # supervision costs a worker thread (and XLA:CPU compiles run ~2x
+    # slower off the main thread, PERF.md round 9): arm it only where a
+    # hang is possible — real accelerator platforms — or demanded
+    # (injection, ABPOA_TPU_WATCHDOG_FORCE)
+    supervised = watchdog.supervision_needed(backend)
+
+    def attempt():
+        inject.pre_dispatch(backend)
+        return fn()
+
+    tries = 1 + _retries()
+    delay = _backoff_base_s()
+    last_exc: BaseException = None
+    last_kind = "dispatch_error"
+    for i in range(tries):
+        try:
+            if supervised:
+                return watchdog.call_with_deadline(attempt, deadline_s,
+                                                   label=label)
+            return attempt()
+        except Exception as e:  # noqa: BLE001 — classified, unknowns re-raise
+            cls = classify(e)
+            if cls is None:
+                raise
+            kind, retryable, breaks = cls
+            last_exc, last_kind = e, kind
+            if breaks:
+                br.record_failure(backend, kind)
+            # no retry once the breaker opened: the demotion is decided
+            retrying = retryable and i + 1 < tries and not br.is_open(backend)
+            if kind == "fused_bail":
+                # a structural bail is a healthy-run fallback, not a fault:
+                # counter only, no faults record
+                count("resilience.fused_bail")
+            else:
+                from ..obs import report
+                report().record_fault(
+                    kind, backend=backend, detail=str(e)[:300],
+                    action="retry" if retrying else "fallback")
+            if not retrying:
+                break
+            count("resilience.retries")
+            time.sleep(delay)
+            delay *= 2
+    raise DispatchFailed(
+        last_kind, f"{label}: {last_kind}: {last_exc}") from last_exc

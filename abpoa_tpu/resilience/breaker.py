@@ -1,0 +1,70 @@
+"""Per-backend circuit breaker: the degradation ladder's memory.
+
+Generalizes the probe-timeout special case (utils/probe.py falls back once,
+at resolution time) into a run-scoped policy: every classified dispatch
+failure is recorded against the backend that failed; past a threshold the
+breaker OPENS and the backend is demoted for the remainder of the run —
+pallas -> jax -> native -> numpy — instead of re-failing (and re-paying
+retries, watchdog deadlines, or re-compiles) on every subsequent read.
+
+Openings are never silent: each one warns on stderr once, increments
+`breaker.open.<backend>`, and lands in the run report's `degraded` block
+(schema v3). `obs.start_run()` resets the breaker, so demotion is per-run
+state, exactly like the probe verdict's telemetry labels.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict
+
+# the degradation ladder: who serves when a backend is demoted. "numpy"
+# (the host oracle) is the floor and is never demoted — it is the
+# correctness reference everything else is judged against.
+DEMOTION = {"pallas": "jax", "tpu": "jax", "jax": "native",
+            "native": "numpy"}
+
+
+def _threshold() -> int:
+    return max(1, int(os.environ.get("ABPOA_TPU_BREAKER_THRESHOLD", "3")))
+
+
+class CircuitBreaker:
+    def __init__(self) -> None:
+        self.failures: Dict[str, int] = {}
+        self.open: Dict[str, dict] = {}   # backend -> {"to", "kind", "failures"}
+
+    def reset(self) -> None:
+        self.failures.clear()
+        self.open.clear()
+
+    def is_open(self, backend: str) -> bool:
+        return backend in self.open
+
+    def effective(self, backend: str) -> str:
+        """Walk the demotion ladder past every open breaker."""
+        seen = set()
+        while backend in self.open and backend not in seen:
+            seen.add(backend)
+            backend = DEMOTION.get(backend, "numpy")
+        return backend
+
+    def record_failure(self, backend: str, kind: str) -> None:
+        from ..obs import count, report
+        n = self.failures[backend] = self.failures.get(backend, 0) + 1
+        count(f"breaker.failures.{backend}")
+        if n >= _threshold() and backend not in self.open:
+            to = self.effective(DEMOTION.get(backend, "numpy"))
+            self.open[backend] = {"to": to, "kind": kind, "failures": n}
+            count(f"breaker.open.{backend}")
+            report().mark_degraded(backend, to, kind, n)
+            print(f"Warning: backend '{backend}' circuit breaker opened "
+                  f"after {n} dispatch failures (last: {kind}); using "
+                  f"'{to}' for the remainder of the run.", file=sys.stderr)
+
+
+_BREAKER = CircuitBreaker()
+
+
+def breaker() -> CircuitBreaker:
+    return _BREAKER

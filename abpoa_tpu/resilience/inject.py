@@ -1,0 +1,190 @@
+"""Fault-injection harness: every failure mode the dispatch layer defends
+against, armable deterministically on any host (no TPU, no broken hardware
+needed).
+
+Arm via the environment (read once per process, at first use):
+
+    ABPOA_TPU_INJECT=compile_fail             # fire on every device dispatch
+    ABPOA_TPU_INJECT=oom:2,hang               # oom twice, then hang forever
+    ABPOA_TPU_INJECT=garbage:1                # corrupt one dispatch result
+
+or programmatically with `configure("kind[:count],...")` (tests). A bare
+kind fires on every matching dispatch; `kind:N` fires N times and then
+disarms itself. Each firing is counted (`inject.<kind>` in the run report)
+so a chaos run can assert the injector actually fired.
+
+Kinds and where they fire:
+
+- ``compile_fail``  device dispatch (jax/pallas): raises a compile-shaped
+                    RuntimeError before the kernel runs
+- ``oom``           device dispatch: raises RESOURCE_EXHAUSTED-shaped error
+- ``hang``          device dispatch: sleeps ABPOA_TPU_INJECT_HANG_S (default
+                    30 s) inside the watchdog-supervised worker, so the
+                    dispatch deadline trips exactly like a wedged kernel
+- ``garbage``       after a dispatch: corrupts the result (absurd score +
+                    truncated CIGAR, or an out-of-alphabet graph base) so
+                    the output guards must catch it
+- ``native_crash``  native host-kernel dispatch: raises the same error shape
+                    as a non-zero ``apg_align`` return
+- ``poison_set``    set ingestion: raises PoisonedSetError, exercising the
+                    per-set quarantine path
+
+Everything here is inert when disarmed: the hot-path check is one global
+boolean (`_ANY`).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+
+class InjectedFault(RuntimeError):
+    """Base for injected failures; `kind` routes classification."""
+    kind = "injected"
+
+
+class InjectedCompileFailure(InjectedFault):
+    kind = "compile_fail"
+
+
+class InjectedDeviceOOM(InjectedFault):
+    kind = "oom"
+
+
+class InjectedNativeCrash(InjectedFault):
+    kind = "native_crash"
+
+
+class InjectedHang(InjectedFault):
+    kind = "hang"
+
+
+KINDS = ("compile_fail", "oom", "hang", "garbage", "native_crash",
+         "poison_set")
+
+# kind -> remaining shots (-1 = unlimited); absent = disarmed
+_SPEC: Dict[str, int] = {}
+_ANY = False
+_CONFIGURED = False
+
+
+def configure(spec: Optional[str] = None) -> None:
+    """Parse an injection spec ("kind[:count],..."); None reads
+    ABPOA_TPU_INJECT. Unknown kinds raise (a typo'd chaos run must not
+    silently test nothing)."""
+    global _ANY, _CONFIGURED
+    if spec is None:
+        spec = os.environ.get("ABPOA_TPU_INJECT", "")
+    _SPEC.clear()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, cnt = part.partition(":")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault-injection kind: {kind!r} "
+                             f"(known: {', '.join(KINDS)})")
+        _SPEC[kind] = int(cnt) if cnt else -1
+    _ANY = bool(_SPEC)
+    _CONFIGURED = True
+
+
+def reset() -> None:
+    """Disarm every injector (tests)."""
+    configure("")
+
+
+def _ensure_configured() -> None:
+    if not _CONFIGURED:
+        configure(None)
+
+
+def armed(kind: str) -> bool:
+    _ensure_configured()
+    return _SPEC.get(kind, 0) != 0
+
+
+def any_armed() -> bool:
+    _ensure_configured()
+    return _ANY
+
+
+def fire(kind: str) -> bool:
+    """Consume one shot of `kind` if armed. Counted in the run report so
+    chaos tests can assert the injector really fired. The hot-path cost
+    when disarmed is the two boolean checks."""
+    if not _CONFIGURED:
+        configure(None)
+    if not _ANY:
+        return False
+    left = _SPEC.get(kind, 0)
+    if left == 0:
+        return False
+    if left > 0:
+        _SPEC[kind] = left - 1
+    from ..obs import count
+    count(f"inject.{kind}")
+    return True
+
+
+def hang_seconds() -> float:
+    return float(os.environ.get("ABPOA_TPU_INJECT_HANG_S", "30"))
+
+
+def pre_dispatch(backend: str) -> None:
+    """Injection point at the top of a dispatch attempt. Runs INSIDE the
+    watchdog-supervised worker for device backends, so an injected hang
+    trips the deadline exactly like a real wedged kernel."""
+    if not _CONFIGURED:
+        configure(None)
+    if not _ANY:
+        return
+    if backend in ("jax", "tpu", "pallas"):
+        if fire("compile_fail"):
+            raise InjectedCompileFailure(
+                f"injected XLA compilation failure ({backend})")
+        if fire("oom"):
+            raise InjectedDeviceOOM(
+                "RESOURCE_EXHAUSTED: injected device OOM while allocating "
+                "DP planes")
+        if fire("hang"):
+            # sleep past the watchdog deadline (the main thread times out
+            # and degrades), then raise instead of falling through: the
+            # abandoned worker must not burn CPU on a dispatch whose
+            # result is already discarded
+            time.sleep(hang_seconds())
+            raise InjectedHang(
+                f"injected dispatch hang ({hang_seconds():.1f}s)")
+    elif backend == "native":
+        if fire("native_crash"):
+            raise InjectedNativeCrash(
+                "native DP kernel failed (rc=-11, injected crash)")
+
+
+def corrupt_result(res):
+    """Garbage injector for per-read dispatch results: an absurd score and
+    a truncated CIGAR — both invariants the output guards must catch."""
+    if fire("garbage"):
+        res.best_score = 1 << 40
+        res.cigar = list(res.cigar)[: max(0, len(res.cigar) // 2)]
+        res.cigar_arr = None  # the guards must see the corrupted list
+    return res
+
+
+def corrupt_graph_base(base_arr):
+    """Garbage injector for the fused path: poison one downloaded graph
+    base out of the alphabet (what a mis-DMA'd kernel output looks like).
+    Mutates the host array in place; returns True when it fired."""
+    if fire("garbage") and base_arr.size > 2:
+        base_arr[2] = 99
+        return True
+    return False
+
+
+def check_poison_set() -> None:
+    """Set-ingestion injection point: raise a poisoned-set error so the
+    quarantine path runs without needing a malformed file on disk."""
+    if fire("poison_set"):
+        from .quarantine import PoisonedSetError
+        raise PoisonedSetError("injected poisoned read set")

@@ -1,0 +1,142 @@
+"""Memory admission control: estimate before dispatching, never OOM blind.
+
+The fused loop's device footprint is a pure function of its compile-ladder
+rung — the bucketed capacities (N nodes, E edge slots, A aligned slots, W
+band window, Qp padded query, R padded reads, K lockstep sets) plus plane
+width. That makes OOM *predictable*: estimate the bytes a dispatch will
+ask for BEFORE dispatching, and when it exceeds the budget, proactively
+chunk the lockstep group into smaller K (linear in K) or demote the set to
+the host kernel — instead of letting the allocator discover it mid-run.
+
+The model is deliberately simple (the same order-of-magnitude arithmetic
+`lockstep_group_size()`'s docstring did by hand): per-set DP planes
+(n_planes x N x W cells), graph tables (N x E edges in/out, N x A aligned),
+and the padded read batch. It only needs to be right within ~2x — the
+budget carries the safety margin.
+
+Budget: ``ABPOA_TPU_MEM_BUDGET_MB`` (0 disables admission). Without the
+env var, admission is active only when the jax default backend is a real
+accelerator (fixed HBM); host RAM is elastic and the host backends
+allocate nothing on-device.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from .. import constants as C
+
+# DP planes per gap mode: H (+E/F per affine level). Conservative by one —
+# the scan keeps score and direction state per plane.
+_N_PLANES = {C.LINEAR_GAP: 2, C.AFFINE_GAP: 4, C.CONVEX_GAP: 6}
+
+_DEFAULT_ACCEL_BUDGET_MB = 14_000   # 16 GB HBM minus runtime slack
+
+
+def budget_bytes() -> Optional[int]:
+    """None = admission disabled."""
+    env = os.environ.get("ABPOA_TPU_MEM_BUDGET_MB")
+    if env is not None:
+        mb = float(env)
+        return int(mb * 1e6) if mb > 0 else None
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        if jax.default_backend() != "cpu":
+            return _DEFAULT_ACCEL_BUDGET_MB * 10 ** 6
+    except RuntimeError:
+        pass
+    return None
+
+
+def estimate_bytes(caps: dict) -> int:
+    """Device bytes one fused/lockstep dispatch will hold, from its
+    compile-ladder rung (fused_loop.plan_dispatch_footprint)."""
+    N, E, A = caps["N"], caps["E"], caps["A"]
+    W, Qp, R = caps["W"], caps["Qp"], caps["reads"]
+    K = caps.get("K", 1)
+    m = caps.get("m", 5)
+    cell = 2 if caps.get("plane16") else 4
+    planes = _N_PLANES.get(caps.get("gap_mode", C.CONVEX_GAP), 6)
+    per_set = (planes * N * min(W, Qp + 1) * cell   # DP planes
+               + N * E * 4 * 4                      # in/out ids + weights
+               + N * A * 4                          # aligned groups
+               + N * 12 * 4                         # per-node scalars/order
+               + R * Qp * (8 + 4 * m))              # reads, weights, qp table
+    return K * per_set
+
+
+def per_set_bytes(caps: dict) -> int:
+    return estimate_bytes(dict(caps, K=1))
+
+
+def admit(caps: dict) -> Tuple[str, int, Optional[int]]:
+    """-> (decision, estimated_bytes, budget_bytes).
+
+    "ok"     fits (or admission disabled)
+    "chunk"  the K-set group exceeds the budget but single sets fit:
+             dispatch in smaller sub-groups (`max_sets_within`)
+    "demote" even one set exceeds the budget: run it on the host kernel
+    """
+    from ..obs import count
+    budget = budget_bytes()
+    est = estimate_bytes(caps)
+    if budget is None or est <= budget:
+        return "ok", est, budget
+    count("admission.over_budget")
+    if caps.get("K", 1) > 1 and per_set_bytes(caps) <= budget:
+        count("admission.chunk")
+        return "chunk", est, budget
+    count("admission.demote")
+    return "demote", est, budget
+
+
+def max_sets_within(caps: dict) -> int:
+    """Largest lockstep K whose estimate fits the budget (>= 1).
+
+    Accounts for the set-axis rung padding: the lockstep dispatch snaps K
+    up to `k_rung` (pow2) and the padding slots allocate full plane
+    stacks even though they are born finished — so a piece is admitted
+    only if its PADDED K fits, or the "admitted" chunk would OOM exactly
+    like the unchunked group."""
+    budget = budget_bytes()
+    k_req = max(1, caps.get("K", 1))
+    if budget is None:
+        return k_req
+    from ..compile.ladder import k_rung
+    per_set = max(1, per_set_bytes(caps))
+    best = 1
+    for k in range(1, k_req + 1):
+        if k_rung(k) * per_set <= budget:
+            best = k
+    return best
+
+
+def admission_plan(abpt, entries, seqs_of) -> List[Tuple[list, str]]:
+    """Partition a same-bucket lockstep sub-batch into admissible pieces.
+
+    entries: planner tuples; seqs_of(entry) -> that entry's encoded reads.
+    Returns [(piece, action)] in input order, action "dispatch" (run on
+    device) or "demote" (route to the host path — even a K=1 dispatch of
+    these sets would exceed the budget; chunking cannot help because
+    planes scale with the set's own Qp/N, not with K). The common case —
+    everything fits — costs one footprint estimate and returns one
+    dispatchable piece."""
+    from ..align.fused_loop import plan_dispatch_footprint
+    sets = [seqs_of(e) for e in entries]
+    caps = plan_dispatch_footprint(abpt, sets)
+    decision, est, budget = admit(caps)
+    if decision == "ok":
+        return [(list(entries), "dispatch")]
+    if decision == "demote":
+        from ..obs import report
+        report().record_fault(
+            "admission", backend=getattr(abpt, "device", None),
+            detail=f"estimated {est} B > budget {budget} B per set",
+            action="demote")
+        return [(list(entries), "demote")]
+    k_fit = max_sets_within(caps)
+    return [(list(entries[i:i + k_fit]), "dispatch")
+            for i in range(0, len(entries), k_fit)]

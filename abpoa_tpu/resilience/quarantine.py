@@ -1,0 +1,72 @@
+"""Per-set quarantine: one poisoned read set must never drop the batch.
+
+The `-l` file-list mode and `msa_batch` process independent read sets; the
+reference aborts the whole process on the first bad file (src/abpoa.c:148-
+168 has no error path). Here a set that fails validation — malformed
+record, empty sequence, truncated FASTQ, unreadable/corrupt file, a size
+past the admission cap — is quarantined: it produces a structured per-set
+error (a `faults` record with the set index plus one stderr line), the
+counters tick, and every healthy set completes normally. A traceback or a
+partial silent result is a bug; tests/test_resilience.py fuzzes exactly
+that contract.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+
+class PoisonedSetError(ValueError):
+    """A read set rejected by input validation (quarantinable)."""
+
+
+# exception types the per-set boundary converts into quarantine instead of
+# propagating: malformed input and I/O decay. Anything else (TypeError,
+# KeyError, ...) is a real bug and must surface.
+QUARANTINE_EXCEPTIONS = (PoisonedSetError, OSError, EOFError,
+                         UnicodeDecodeError)
+
+
+def max_reads_per_set() -> int:
+    """Admission cap on reads per set (matches the per-read telemetry
+    stream's READS_CAP by default): an input claiming millions of reads is
+    quarantined up front instead of exhausting host memory mid-ingest."""
+    return int(os.environ.get("ABPOA_TPU_MAX_READS", "100000"))
+
+
+def validate_records(records, abpt=None, label: str = "") -> None:
+    """Structural validation of parsed FASTA/FASTQ records; raises
+    PoisonedSetError with a reason a user can act on. O(records) host
+    checks on lengths only — never re-scans sequence bytes."""
+    from .inject import check_poison_set
+    check_poison_set()
+    if not records:
+        raise PoisonedSetError("no sequence records parsed "
+                               "(empty or malformed file)")
+    cap = max_reads_per_set()
+    if len(records) > cap:
+        raise PoisonedSetError(
+            f"{len(records)} reads exceeds the per-set cap of {cap} "
+            "(ABPOA_TPU_MAX_READS)")
+    for i, rec in enumerate(records):
+        if not rec.seq:
+            raise PoisonedSetError(
+                f"record {i} ({rec.name or 'unnamed'}): empty sequence")
+        if rec.qual is not None and len(rec.qual) != len(rec.seq):
+            raise PoisonedSetError(
+                f"record {i} ({rec.name or 'unnamed'}): FASTQ quality "
+                f"length {len(rec.qual)} != sequence length {len(rec.seq)} "
+                "(truncated record?)")
+
+
+def quarantine_set(index: int, label: str, exc: Exception) -> None:
+    """Record one quarantined set: a `faults` entry keyed by set index, a
+    counter, and a single structured stderr line."""
+    from ..obs import count, report
+    count("quarantine.sets")
+    reason = f"{type(exc).__name__}: {exc}"
+    report().record_fault("poisoned_set", set_index=index,
+                          detail=reason[:300], action="quarantined")
+    print(f"[abpoa-tpu] set {index} ({label}) quarantined: {reason}",
+          file=sys.stderr)

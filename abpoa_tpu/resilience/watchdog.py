@@ -1,0 +1,121 @@
+"""Dispatch watchdog: a wall-clock deadline on device dispatches.
+
+A wedged accelerator can hang mid-run in ways the out-of-process liveness
+probe (utils/probe.py) cannot see: the probe answered at startup, then the
+tunnel died under a kernel. The reference's CPU dispatch can never hang
+(src/abpoa_dispatch_simd.c:56-78); the device analog is to run every
+dispatch in a supervised worker thread and abandon it past a deadline —
+the thread cannot be killed, but the run can degrade to a host kernel
+instead of blocking forever (a hung device call blocks in C with the GIL
+released, so the main thread stays live).
+
+Host-kernel dispatches (native/numpy) never route through here: they
+cannot hang by construction, and the quick tier must not pay a thread
+spawn per read (the resilience overhead guard in tests/test_resilience.py
+asserts exactly that).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Callable
+
+
+class DispatchTimeout(RuntimeError):
+    """A supervised dispatch produced no result within its deadline."""
+
+
+# abandoned workers (deadline expired, dispatch still running). A daemon
+# thread executing native device code during interpreter teardown can
+# crash the exiting process (observed: XLA compile -> "terminate called
+# without an active exception" + SIGSEGV at exit), which would turn a
+# successfully-degraded run into rc=-11. At exit, grant stragglers a
+# bounded grace to finish; a truly wedged thread is abandoned for real
+# after the grace — by then all output and the exit status are flushed.
+_ABANDONED: list = []
+_EXIT_GRACE_S = float(os.environ.get("ABPOA_TPU_WATCHDOG_EXIT_GRACE_S", "15"))
+
+
+def _drain_abandoned() -> None:
+    import time
+    deadline = time.monotonic() + _EXIT_GRACE_S
+    for t in _ABANDONED:
+        t.join(max(0.0, deadline - time.monotonic()))
+
+
+atexit.register(_drain_abandoned)
+
+
+def deadline_seconds() -> float:
+    """Per-dispatch deadline. Generous by default: a cold first-sight XLA
+    compile of a 10 kb-workload fused chunk is minutes (PERF.md round 8),
+    and a deadline must never fire on honest work. 0 disables supervision
+    (direct call)."""
+    return float(os.environ.get("ABPOA_TPU_WATCHDOG_S", "900"))
+
+
+def supervision_needed(backend: str) -> bool:
+    """Should this dispatch run in the supervised worker?
+
+    Only device backends can hang, and only through a wedged accelerator
+    tunnel — the CPU jax backend cannot (the same reasoning that scopes
+    the liveness probe, utils/probe.py), and thread-supervised XLA:CPU
+    compiles measure ~2x slower than main-thread ones (PERF.md round 9).
+    So supervision arms for real accelerator platforms, when a fault
+    injector is armed (tests/chaos need the deadline on CPU), or under
+    ABPOA_TPU_WATCHDOG_FORCE=1."""
+    if backend not in ("jax", "tpu", "pallas"):
+        return False
+    if os.environ.get("ABPOA_TPU_WATCHDOG_FORCE") == "1":
+        return True
+    from .inject import any_armed
+    if any_armed():
+        return True
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        # not imported yet: the dispatch itself would initialize jax;
+        # supervise, since we cannot rule out an accelerator platform
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except RuntimeError:
+        return True
+
+
+def call_with_deadline(fn: Callable, deadline_s: float = None,
+                       label: str = "dispatch"):
+    """Run fn() in a daemon worker; raise DispatchTimeout past the
+    deadline. Exceptions from fn propagate unchanged. On timeout the
+    worker is abandoned (counted), never joined — a genuinely hung device
+    call cannot be interrupted, only routed around."""
+    if deadline_s is None:
+        deadline_s = deadline_seconds()
+    if deadline_s <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def worker():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"abpoa-watchdog:{label}")
+    t.start()
+    if not done.wait(deadline_s):
+        from ..obs import count
+        count("watchdog.timeouts")
+        count("watchdog.abandoned_threads")
+        _ABANDONED.append(t)
+        raise DispatchTimeout(
+            f"{label}: no result within {deadline_s:.1f}s watchdog deadline "
+            "(wedged device dispatch?)")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]

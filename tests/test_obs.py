@@ -47,7 +47,9 @@ def test_report_schema_golden():
     rep = a.last_report
     assert tuple(rep.keys()) == obs.SCHEMA_KEYS
     assert rep["schema"] == obs.SCHEMA
-    assert rep["schema_version"] == obs.SCHEMA_VERSION == 2
+    assert rep["schema_version"] == obs.SCHEMA_VERSION == 3
+    # v3: a clean run carries no fault history and no demotions
+    assert rep["faults"] is None and rep["degraded"] is None
     assert rep["counters"]["dispatch.numpy"] == 2
     assert rep["counters"]["dp.cells"] > 0
     assert {"align", "fusion", "consensus"} <= set(rep["phases"])
@@ -83,7 +85,7 @@ def test_cli_report_sim2k(tmp_path):
     assert rc == 0
     with open(rpt) as fp:
         rep = json.load(fp)
-    assert rep["schema_version"] == 2
+    assert rep["schema_version"] == 3
     assert rep["counters"]["dispatch.native"] > 0
     assert rep["counters"]["dp.cells"] > 0
     assert rep["values"]["dp.band_width"]["max"] > 0
@@ -102,6 +104,7 @@ def test_lockstep_report_counters():
     obs.start_run()
     abpt = Params()
     abpt.device = "jax"
+    abpt.lockstep = "on"  # CPU-only host: lockstep is opt-in (round 8)
     abpt.finalize()
     out = io.StringIO()
     run_batch([os.path.join(DATA_DIR, "test.fa"),
@@ -359,7 +362,7 @@ def test_report_viewer(tmp_path):
     with open(rpt) as fp:
         rep = json.load(fp)
     text = render_report(rep)
-    assert "run report (schema v2)" in text
+    assert "run report (schema v3)" in text
     for name in rep["phases"]:
         assert name in text
     assert "p50" in text and "dispatch.native" in text

@@ -16,6 +16,7 @@ def test_run_batch_multidevice():
     assert len(jax.devices()) == 8
     abpt = Params()
     abpt.device = "jax"
+    abpt.lockstep = "on"  # CPU-only host: lockstep is opt-in (round 9)
     abpt.finalize()
     out = io.StringIO()
     files = [os.path.join(DATA_DIR, "test.fa"), os.path.join(DATA_DIR, "test.fa")]
@@ -138,6 +139,7 @@ def test_run_batch_mixed_eligibility(tmp_path):
 
     abpt = Params()
     abpt.device = "jax"
+    abpt.lockstep = "on"  # CPU-only host: lockstep is opt-in (round 9)
     abpt.finalize()
     out = io.StringIO()
     run_batch(files, abpt, out)
@@ -175,6 +177,7 @@ def test_run_batch_8_sets_matches_sequential(tmp_path):
 
     abpt = Params()
     abpt.device = "jax"
+    abpt.lockstep = "on"  # CPU-only host: lockstep is opt-in (round 9)
     abpt.finalize()
     out = io.StringIO()
     run_batch(files, abpt, out)

@@ -85,7 +85,9 @@ def test_msa_batch_lockstep_parity():
     # different length buckets: msa_batch partitions into same-bucket
     # sub-batches; results must still come back in input order
     sets = [mkset(0), mkset(1, L=400), mkset(2)]
-    dev = pa.msa_aligner(device="jax")
+    # lockstep="on": CPU-only hosts default to the serial K=1 path
+    # (round 8 measurement); this test exercises the vmapped path itself
+    dev = pa.msa_aligner(device="jax", lockstep="on")
     batch = dev.msa_batch(sets, out_cons=True, out_msa=True)
     for k, ss in enumerate(sets):
         host = pa.msa_aligner(device="numpy")

@@ -1,0 +1,536 @@
+"""Resilient-dispatch tests (ISSUE 8): fault injection, degradation
+ladder, watchdog, output guards, memory admission, per-set quarantine.
+
+Every injector must leave the run COMPLETE and CORRECT (healthy sets
+byte-match a clean host run) with the failure visible in the report
+(`faults` records, `degraded` block, quarantine counters) — and with
+injection disarmed the resilience layer must cost nothing measurable
+(overhead guard, same contract as the obs guard)."""
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from conftest import DATA_DIR
+
+TEST_FA = os.path.join(DATA_DIR, "test.fa")
+SIM2K = os.path.join(DATA_DIR, "sim2k.fa")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Injection spec and breaker are process-global: every test starts
+    and ends disarmed/closed."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    rz.inject.reset()
+    rz.breaker().reset()
+    rz.set_enabled(True)
+    yield
+    rz.inject.reset()
+    rz.breaker().reset()
+    rz.set_enabled(True)
+    obs.start_run()
+
+
+def _native_or_skip():
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+
+
+def _run_file(device, path=TEST_FA):
+    from abpoa_tpu import obs
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    obs.start_run()
+    abpt = Params()
+    abpt.device = device
+    abpt.finalize()
+    out = io.StringIO()
+    msa_from_file(Abpoa(), abpt, path, out)
+    return out.getvalue(), obs.finalize_report()
+
+
+# --------------------------------------------------------------------- #
+# injector harness                                                       #
+# --------------------------------------------------------------------- #
+
+def test_inject_spec_parsing():
+    from abpoa_tpu import resilience as rz
+    rz.inject.configure("oom:2,hang")
+    assert rz.inject.armed("oom") and rz.inject.armed("hang")
+    assert not rz.inject.armed("garbage")
+    assert rz.inject.fire("oom") and rz.inject.fire("oom")
+    assert not rz.inject.fire("oom")      # 2 shots consumed
+    assert rz.inject.fire("hang")         # unlimited
+    with pytest.raises(ValueError, match="unknown fault-injection kind"):
+        rz.inject.configure("frobnicate")
+    rz.inject.reset()
+    assert not rz.inject.fire("hang")
+
+
+def test_breaker_demotion_ladder():
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    br = rz.breaker()
+    thr = int(os.environ.get("ABPOA_TPU_BREAKER_THRESHOLD", "3"))
+    for _ in range(thr):
+        br.record_failure("jax", "oom")
+    assert br.is_open("jax")
+    assert br.effective("jax") == "native"
+    assert br.effective("pallas") == "pallas"   # pallas itself is healthy
+    for _ in range(thr):
+        br.record_failure("pallas", "oom")
+    assert br.effective("pallas") == "native"   # pallas -> jax(open) -> native
+    for _ in range(thr):
+        br.record_failure("native", "native_crash")
+    assert br.effective("jax") == "numpy"       # whole ladder walked
+    rep = obs.finalize_report()
+    assert set(rep["degraded"]) == {"jax", "pallas", "native"}
+    assert rep["degraded"]["jax"]["to"] == "native"
+    # a new run closes the breakers (run-scoped demotion)
+    obs.start_run()
+    assert not br.is_open("jax")
+
+
+def test_watchdog_deadline():
+    from abpoa_tpu import resilience as rz
+    assert rz.watchdog.call_with_deadline(lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(ZeroDivisionError):
+        rz.watchdog.call_with_deadline(lambda: 1 // 0, 5.0)
+    with pytest.raises(rz.DispatchTimeout):
+        rz.watchdog.call_with_deadline(lambda: time.sleep(3), 0.1,
+                                       label="t")
+    # deadline 0 = supervision off: direct call, no thread
+    assert rz.watchdog.call_with_deadline(lambda: "x", 0) == "x"
+
+
+def test_classify_exceptions():
+    from abpoa_tpu import resilience as rz
+    assert rz.classify(rz.InjectedDeviceOOM("x"))[0] == "oom"
+    assert rz.classify(RuntimeError("RESOURCE_EXHAUSTED: oom"))[0] == "oom"
+    assert rz.classify(RuntimeError("XLA compilation failed"))[0] \
+        == "compile_fail"
+    kind, retryable, breaks = rz.classify(
+        RuntimeError("fused loop: 3 sequential-fusion fallbacks"))
+    assert kind == "fused_bail" and not retryable and not breaks
+    assert rz.classify(rz.DispatchTimeout("t"))[0] == "hang"
+    assert rz.classify(TypeError("bug")) is None    # real bugs propagate
+
+
+# --------------------------------------------------------------------- #
+# output guards                                                          #
+# --------------------------------------------------------------------- #
+
+def test_guard_cigar_invariants():
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.align.result import AlignResult
+    from abpoa_tpu.cigar import push_cigar
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.resilience.guards import align_result_violation
+    abpt = Params().finalize()
+    res = AlignResult()
+    for q in range(4):
+        push_cigar(res.cigar, C.CMATCH, 1, q + 2, q)
+    res.best_score = 8
+    assert align_result_violation(res, 4, 10, abpt) is None
+    # truncated cigar: global mode must consume the whole query
+    res.cigar = res.cigar[:2]
+    assert "consumes 2 of 4" in align_result_violation(res, 4, 10, abpt)
+    # absurd score
+    res2 = AlignResult()
+    res2.best_score = 1 << 40
+    assert "int32" in align_result_violation(res2, 4, 10, abpt)
+    # over-consumption of graph nodes
+    res3 = AlignResult()
+    push_cigar(res3.cigar, C.CDEL, 50, 2, 0)
+    res3.best_score = 0
+    assert "graph nodes" in align_result_violation(res3, 4, 10, abpt)
+
+
+def test_guard_never_raises_on_unpackable_cigar():
+    """A cigar with a negative entry (int64 backtrack gone wrong — the
+    exact bit-flip threat model) is a VIOLATION, not an OverflowError out
+    of the guard."""
+    from abpoa_tpu.align.result import AlignResult
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.resilience.guards import align_result_violation
+    abpt = Params().finalize()
+    res = AlignResult()
+    res.cigar = [-5]
+    res.best_score = 0
+    assert "uint64" in align_result_violation(res, 4, 10, abpt)
+
+
+def test_breaker_short_circuits_dispatch():
+    """An open breaker fails a guarded dispatch fast — the first attempt
+    (a full watchdog deadline on a wedged backend) is not re-paid."""
+    from abpoa_tpu import resilience as rz
+    br = rz.breaker()
+    for _ in range(int(os.environ.get("ABPOA_TPU_BREAKER_THRESHOLD", "3"))):
+        br.record_failure("jax", "hang")
+    calls = []
+    with pytest.raises(rz.DispatchFailed) as ei:
+        rz.guarded_device_call("t", "jax", lambda: calls.append(1))
+    assert ei.value.kind == "breaker_open"
+    assert not calls, "dispatch attempted despite an open breaker"
+
+
+def test_graph_base_guard():
+    from abpoa_tpu.resilience.guards import GarbageOutput, check_graph_bases
+    check_graph_bases(np.array([0, 1, 2, 3, 4]), 5)
+    with pytest.raises(GarbageOutput):
+        check_graph_bases(np.array([0, 99]), 5)
+
+
+# --------------------------------------------------------------------- #
+# end-to-end injection: each armed injector completes degraded + correct #
+# --------------------------------------------------------------------- #
+
+def test_garbage_injection_native_rerun():
+    """A corrupted native dispatch result trips the output guard and
+    re-runs that read on the host oracle; output stays byte-correct."""
+    _native_or_skip()
+    from abpoa_tpu import resilience as rz
+    want, _ = _run_file("native")
+    rz.inject.configure("garbage:1")
+    got, rep = _run_file("native")
+    assert got == want
+    assert rep["faults"]["kinds"] == {"garbage_output": 1}
+    assert rep["counters"]["guard.dp_violation"] == 1
+    assert rep["counters"]["dispatch.rerun.numpy"] == 1
+
+
+@pytest.mark.parametrize("kind", ["compile_fail", "oom"])
+def test_device_failure_degrades_to_host(kind, monkeypatch):
+    """With the injector armed on every device dispatch, the run demotes
+    jax -> host through the circuit breaker and completes with output
+    identical to the numpy oracle."""
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "2")
+    from abpoa_tpu import resilience as rz
+    want, _ = _run_file("numpy")
+    rz.inject.configure(kind)
+    got, rep = _run_file("jax")
+    assert got == want
+    assert kind in rep["faults"]["kinds"]
+    assert rep["degraded"]["jax"]["to"] in ("native", "numpy")
+    assert rep["counters"]["breaker.open.jax"] == 1
+    # the injected failure fires before any kernel runs: zero compiles paid
+    assert rep["counters"][f"inject.{kind}"] >= 2
+
+
+def test_hang_injection_watchdog_degrades(monkeypatch):
+    """An injected dispatch hang trips the watchdog deadline; the run
+    degrades and completes instead of blocking forever."""
+    monkeypatch.setenv("ABPOA_TPU_WATCHDOG_S", "0.3")
+    monkeypatch.setenv("ABPOA_TPU_INJECT_HANG_S", "1.0")
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "2")
+    from abpoa_tpu import resilience as rz
+    want, _ = _run_file("numpy")
+    rz.inject.configure("hang")
+    t0 = time.perf_counter()
+    got, rep = _run_file("jax")
+    wall = time.perf_counter() - t0
+    assert got == want
+    assert rep["faults"]["kinds"].get("hang", 0) >= 2
+    assert rep["counters"]["watchdog.timeouts"] >= 2
+    assert rep["degraded"]["jax"]["to"] in ("native", "numpy")
+    assert wall < 30, "watchdog did not bound the hang"
+
+
+def test_fused_garbage_graph_guard(monkeypatch):
+    """Garbage injected into the fused loop's downloaded graph is caught
+    by the alphabet guard; the run falls back to the host loop and the
+    output still matches the oracle."""
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "99")
+    from abpoa_tpu import resilience as rz
+    want, _ = _run_file("numpy")
+    rz.inject.configure("garbage")
+    got, rep = _run_file("jax")
+    assert got == want
+    assert rep["faults"]["kinds"].get("garbage_output", 0) >= 1
+
+
+# --------------------------------------------------------------------- #
+# memory admission control                                               #
+# --------------------------------------------------------------------- #
+
+def test_memory_estimate_model():
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.resilience import memory
+    caps = dict(N=4096, E=8, A=8, W=512, Qp=2304, reads=32, K=1,
+                plane16=True, gap_mode=C.CONVEX_GAP, m=5)
+    one = memory.estimate_bytes(caps)
+    assert one > 0
+    assert memory.estimate_bytes(dict(caps, K=8)) == 8 * one
+    assert memory.estimate_bytes(dict(caps, plane16=False)) > one
+
+
+def test_admission_decisions(monkeypatch):
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.resilience import memory
+    caps = dict(N=4096, E=8, A=8, W=512, Qp=2304, reads=32, K=4,
+                plane16=True, gap_mode=C.CONVEX_GAP, m=5)
+    per_set = memory.per_set_bytes(caps)
+    # budget for ~2 sets: chunk
+    monkeypatch.setenv("ABPOA_TPU_MEM_BUDGET_MB",
+                       str(2.5 * per_set / 1e6))
+    decision, _est, _b = memory.admit(caps)
+    assert decision == "chunk"
+    assert memory.max_sets_within(caps) == 2
+    # rung-aware chunking: the dispatch pads K to k_rung (pow2), and the
+    # padding slots allocate real planes — a budget of 5.5 sets admits
+    # k=4 (rung 4), NOT k=5 (rung 8 would allocate 8 sets' planes)
+    caps6 = dict(caps, K=6)
+    monkeypatch.setenv("ABPOA_TPU_MEM_BUDGET_MB",
+                       str(5.5 * per_set / 1e6))
+    assert memory.max_sets_within(caps6) == 4
+    # budget below one set: demote
+    monkeypatch.setenv("ABPOA_TPU_MEM_BUDGET_MB",
+                       str(0.5 * per_set / 1e6))
+    assert memory.admit(caps)[0] == "demote"
+    # 0 disables admission
+    monkeypatch.setenv("ABPOA_TPU_MEM_BUDGET_MB", "0")
+    assert memory.admit(caps)[0] == "ok"
+
+
+def test_admission_demotes_fused_before_dispatch(monkeypatch):
+    """A device run whose planes exceed the budget is demoted to the host
+    loop BEFORE any device dispatch (no OOM, no compile), with the
+    decision visible as a faults record."""
+    monkeypatch.setenv("ABPOA_TPU_MEM_BUDGET_MB", "0.001")
+    from abpoa_tpu import obs
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records, _run_fused_device
+    obs.start_run()
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.finalize()
+    ab = Abpoa()
+    seqs, weights = _ingest_records(ab, abpt, read_fastx(TEST_FA))
+    assert _run_fused_device(ab, abpt, seqs, weights, 0) is False
+    rep = obs.finalize_report()
+    assert rep["faults"]["kinds"] == {"admission": 1}
+    assert rep["counters"]["admission.demote"] == 1
+
+
+# --------------------------------------------------------------------- #
+# per-set quarantine: malformed-input fuzz grid                          #
+# --------------------------------------------------------------------- #
+
+def _write(path, data):
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(path, mode) as fp:
+        fp.write(data)
+    return str(path)
+
+
+def _poison_cases(tmp_path):
+    """(name, file, should_quarantine) — every malformed shape must give
+    a structured per-set error; benign oddities must still align."""
+    return [
+        ("truncated_fastq",
+         _write(tmp_path / "t.fq", "@r1\nACGTACGTAC\n+\n"), True),
+        ("qual_len_mismatch",
+         _write(tmp_path / "q.fq", "@r1\nACGTACGTAC\n+\nIIII\n"), True),
+        ("empty_sequence",
+         _write(tmp_path / "e.fa", ">a\n\n>b\nACGT\n"), True),
+        ("empty_file", _write(tmp_path / "z.fa", ""), True),
+        ("missing_file", str(tmp_path / "nope.fa"), True),
+        ("binary_junk",
+         _write(tmp_path / "b.fa", b"\x1f\x8b\x00garbage-not-gzip"), True),
+        ("over_reads_cap",
+         _write(tmp_path / "big.fa",
+                "".join(f">r{i}\nACGTACGT\n" for i in range(9))), True),
+        ("crlf_endings",
+         _write(tmp_path / "crlf.fa",
+                "".join(ln + "\r\n" for ln in
+                        open(TEST_FA).read().splitlines())), False),
+        ("non_acgt_bytes",
+         _write(tmp_path / "n.fa",
+                ">a\nACGTNRYACGT\n>b\nACGTNNAACGT\n>c\nACGTNRAACGT\n"),
+         False),
+    ]
+
+
+def test_quarantine_fuzz_grid(tmp_path, monkeypatch):
+    """The `-l` batch path over the full malformed-input grid: every
+    poisoned set produces a structured per-set error (faults record with
+    its set index), every healthy set completes, nothing raises."""
+    monkeypatch.setenv("ABPOA_TPU_MAX_READS", "8")   # arm over_reads_cap
+    from abpoa_tpu import obs
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import run_batch
+    cases = _poison_cases(tmp_path)
+    files = [TEST_FA] + [path for _n, path, _q in cases] + [TEST_FA]
+    n_bad = sum(1 for _n, _p, q in cases if q)
+    n_good = len(files) - n_bad
+    obs.start_run()
+    abpt = Params()
+    abpt.device = "numpy"
+    abpt.finalize()
+    out = io.StringIO()
+    stats = run_batch(files, abpt, out)
+    assert stats == {"sets": len(files), "quarantined": n_bad}
+    assert out.getvalue().count(">Consensus_sequence") == n_good
+    rep = obs.finalize_report()
+    assert rep["counters"]["quarantine.sets"] == n_bad
+    recs = [r for r in rep["faults"]["records"]
+            if r["kind"] == "poisoned_set"]
+    bad_idx = sorted(1 + i for i, (_n, _p, q) in enumerate(cases) if q)
+    assert sorted(r["set"] for r in recs) == bad_idx
+    assert all(r.get("detail") for r in recs)
+
+
+def test_crlf_output_matches_lf(tmp_path):
+    """CRLF line endings must parse to the same records (a stray '\\r'
+    would otherwise encode as an ambiguous base and shift the consensus)."""
+    crlf = _write(tmp_path / "crlf.fa",
+                  "".join(ln + "\r\n" for ln in
+                          open(TEST_FA).read().splitlines()))
+    want, _ = _run_file("numpy", TEST_FA)
+    got, _ = _run_file("numpy", crlf)
+    assert got == want
+
+
+def test_single_file_poisoned_cli_rc(tmp_path):
+    """A poisoned single-input CLI run: structured one-line error, rc=1,
+    no traceback. An all-quarantined -l run also fails (rc=1)."""
+    from abpoa_tpu.cli import main
+    bad = _write(tmp_path / "bad.fa", ">a\n\n")
+    assert main([bad, "--device", "numpy",
+                 "-o", str(tmp_path / "o.fa")]) == 1
+    lst = _write(tmp_path / "l.txt", bad + "\n")
+    assert main(["-l", lst, "--device", "numpy",
+                 "-o", str(tmp_path / "o2.fa")]) == 1
+    # one healthy set among poisoned ones -> rc 0
+    lst2 = _write(tmp_path / "l2.txt", bad + "\n" + TEST_FA + "\n")
+    assert main(["-l", lst2, "--device", "numpy",
+                 "-o", str(tmp_path / "o3.fa")]) == 0
+
+
+def test_poison_set_injection(tmp_path):
+    """The poison_set injector quarantines one set without any malformed
+    file on disk (the chaos-smoke CI hook)."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import run_batch
+    rz.inject.configure("poison_set:1")
+    obs.start_run()
+    abpt = Params()
+    abpt.device = "numpy"
+    abpt.finalize()
+    out = io.StringIO()
+    stats = run_batch([TEST_FA, TEST_FA], abpt, out)
+    assert stats["quarantined"] == 1
+    assert out.getvalue().count(">Consensus_sequence") == 1
+    rep = obs.finalize_report()
+    assert rep["counters"]["inject.poison_set"] == 1
+    assert rep["faults"]["kinds"]["poisoned_set"] == 1
+
+
+def test_msa_batch_quarantines_poisoned_set():
+    """pyapi.msa_batch: a poisoned set returns None in its slot (reported
+    per set), the remaining sets complete with correct results."""
+    import abpoa_tpu.pyapi as pa
+    from abpoa_tpu import obs  # noqa: F401
+    sets = [["ACGTACGTAA", "ACGTACGTA", "ACGTTCGTAA"],
+            ["ACGTACGTAA", "", "ACGTTCGTAA"],       # poisoned: empty read
+            ["TTGCAACGTA", "TTGCAACGT", "TTGCATCGTA"]]
+    a = pa.msa_aligner(device="numpy")
+    batch = a.msa_batch(sets, out_cons=True, out_msa=False)
+    assert batch[1] is None
+    for k in (0, 2):
+        want = pa.msa_aligner(device="numpy").msa(sets[k], True, False)
+        assert batch[k].cons_seq == want.cons_seq
+    rep = a.last_report
+    assert rep["faults"]["kinds"]["poisoned_set"] == 1
+    assert rep["counters"]["quarantine.sets"] == 1
+
+
+# --------------------------------------------------------------------- #
+# report viewer + schema                                                 #
+# --------------------------------------------------------------------- #
+
+def test_faults_cap_and_drops():
+    import importlib
+    R = importlib.import_module("abpoa_tpu.obs.report")
+    rep = R.RunReport()
+    for i in range(R.FAULTS_CAP + 10):
+        rep.record_fault("oom", backend="jax", detail=f"f{i}")
+    blk = rep._faults_block()
+    assert blk["count"] == R.FAULTS_CAP + 10
+    assert blk["dropped"] == 10
+    assert len(blk["records"]) == R.FAULTS_CAP
+    assert rep.counters["faults.oom"] == R.FAULTS_CAP + 10
+
+
+def test_report_viewer_renders_faults():
+    from abpoa_tpu.obs.report import RunReport, render_report
+    rep = RunReport()
+    rep.record_fault("oom", backend="jax", detail="RESOURCE_EXHAUSTED",
+                     action="retry")
+    rep.record_fault("poisoned_set", set_index=3, detail="empty sequence",
+                     action="quarantined")
+    rep.mark_degraded("jax", "native", "oom", 3)
+    text = render_report(rep.as_dict())
+    assert "faults: 2" in text
+    assert "oom" in text and "set 3" in text
+    assert "degraded (circuit breakers open at end of run):" in text
+    assert "jax -> native" in text
+    assert "quarantined sets: 1" in text
+
+
+# --------------------------------------------------------------------- #
+# overhead: disarmed resilience must cost nothing measurable             #
+# --------------------------------------------------------------------- #
+
+def test_host_path_never_spawns_watchdog(monkeypatch):
+    """Structural no-new-syncs guard: with injection disarmed, a host-
+    backend run must never route through the watchdog worker (no threads,
+    no deadline waits on the hot path)."""
+    _native_or_skip()
+    from abpoa_tpu.resilience import watchdog
+
+    def boom(*a, **kw):
+        raise AssertionError("watchdog used on a host dispatch")
+
+    monkeypatch.setattr(watchdog, "call_with_deadline", boom)
+    out, rep = _run_file("native", SIM2K)
+    assert out.startswith(">")
+    assert rep["counters"]["dispatch.native"] > 0
+
+
+def test_overhead_guard_resilience_disarmed():
+    """Warm sim2k wall with the resilience envelope active (guards +
+    injection checks, disarmed) stays within noise of the kill switch —
+    the <2% intent of the acceptance bar, asserted with the same loose
+    scheduler-jitter bound the obs overhead guard uses."""
+    _native_or_skip()
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    def run_once():
+        abpt = Params()
+        abpt.device = "native"
+        abpt.finalize()
+        t0 = time.perf_counter()
+        msa_from_file(Abpoa(), abpt, SIM2K, io.StringIO())
+        return time.perf_counter() - t0
+
+    run_once()  # warm
+    try:
+        rz.set_enabled(True)
+        on = min(run_once() for _ in range(3))
+        rz.set_enabled(False)
+        off = min(run_once() for _ in range(3))
+    finally:
+        rz.set_enabled(True)
+    assert on <= off * 1.25 + 0.05, (on, off)

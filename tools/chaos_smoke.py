@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Chaos smoke: run the quick tier with each fault injector armed in turn.
+
+The CI job (`chaos-smoke` in .github/workflows/ci.yml) and any operator can
+prove the resilient-dispatch contract end to end: with compile failure,
+device OOM, dispatch hang, garbage kernel output, or a poisoned read set
+injected (ABPOA_TPU_INJECT=..., abpoa_tpu/resilience/inject.py), a multi-set
+`-l` run must
+
+- exit rc=0 (healthy sets complete; the run degrades, never dies),
+- emit a consensus for every healthy set,
+- carry the corresponding `faults` records — plus the circuit-breaker
+  `degraded` block or quarantine counters — in the --report JSON.
+
+Each injector runs in a fresh subprocess (injection spec and breaker state
+are process-global). The device backend is `jax` pinned to CPU, so this
+needs no accelerator; the injectors fire before any kernel runs, so no XLA
+compile is paid for the fail-shaped runs.
+
+    python tools/chaos_smoke.py [--keep] [--only KIND]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+DATA = os.path.join(REPO, "tests", "data")
+
+# injector -> (expected fault kind, expect breaker-degraded block)
+SCENARIOS = {
+    "compile_fail": ("compile_fail", True),
+    "oom": ("oom", True),
+    "hang": ("hang", True),
+    "garbage": ("garbage_output", False),
+    "poison_set:1": ("poisoned_set", False),
+}
+
+
+def run_one(spec: str, tmp: str, verbose: bool) -> list:
+    """Run the multi-set workload with `spec` armed; return failure strings."""
+    name = spec.split(":")[0]
+    lst = os.path.join(tmp, f"list_{name}.txt")
+    with open(lst, "w") as fp:
+        for _ in range(3):
+            fp.write(os.path.join(DATA, "test.fa") + "\n")
+    out = os.path.join(tmp, f"out_{name}.fa")
+    rpt = os.path.join(tmp, f"report_{name}.json")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ABPOA_TPU_SKIP_PROBE="1",
+        ABPOA_TPU_INJECT=spec,
+        ABPOA_TPU_BREAKER_THRESHOLD="2",
+    )
+    if name == "hang":
+        # short injected hang + tight deadline — ONLY for the hang
+        # scenario: a tight deadline on the others would trip on honest
+        # first-sight compiles, which is exactly what the default
+        # deadline is sized to never do
+        env["ABPOA_TPU_INJECT_HANG_S"] = "1.0"
+        env["ABPOA_TPU_WATCHDOG_S"] = "0.5"
+    proc = subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", "-l", lst, "--device", "jax",
+         "-o", out, "--report", rpt],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    failures = []
+    expected_kind, expect_degraded = SCENARIOS[spec]
+    if proc.returncode != 0:
+        return [f"{name}: rc={proc.returncode} (must complete degraded, "
+                f"rc=0)\nstderr:\n{proc.stderr[-2000:]}"]
+    n_expected = 2 if name == "poison_set" else 3
+    with open(out) as fp:
+        n_cons = fp.read().count(">Consensus_sequence")
+    if n_cons != n_expected:
+        failures.append(f"{name}: {n_cons} consensus sequences, "
+                        f"expected {n_expected}")
+    with open(rpt) as fp:
+        rep = json.load(fp)
+    kinds = (rep.get("faults") or {}).get("kinds") or {}
+    if not kinds.get(expected_kind):
+        failures.append(f"{name}: no '{expected_kind}' faults record "
+                        f"(kinds: {kinds})")
+    if not rep["counters"].get(f"inject.{name}"):
+        failures.append(f"{name}: injector never fired")
+    if expect_degraded and not rep.get("degraded"):
+        failures.append(f"{name}: breaker never opened (degraded block "
+                        "missing)")
+    if name == "poison_set" and not rep["counters"].get("quarantine.sets"):
+        failures.append(f"{name}: quarantine counter missing")
+    if verbose:
+        print(f"[chaos-smoke] {name}: rc=0, {n_cons} consensus, "
+              f"faults={kinds}, degraded={sorted(rep.get('degraded') or {})}")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", default=None,
+                    help="run a single injector (e.g. 'hang')")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir for inspection")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    specs = [s for s in SCENARIOS
+             if args.only is None or s.split(":")[0] == args.only]
+    if not specs:
+        print(f"[chaos-smoke] unknown injector {args.only!r}",
+              file=sys.stderr)
+        return 2
+    tmp = tempfile.mkdtemp(prefix="abpoa_chaos_")
+    failures = []
+    for spec in specs:
+        failures.extend(run_one(spec, tmp, verbose=not args.quiet))
+    if args.keep:
+        print(f"[chaos-smoke] work dir kept: {tmp}")
+    if failures:
+        for f in failures:
+            print(f"[chaos-smoke] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[chaos-smoke] PASS: {len(specs)} injectors, every run "
+          "completed degraded with the expected fault records")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
