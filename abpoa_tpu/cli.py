@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -76,6 +77,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "to FILE ('-' for stdout; falls to stderr when "
                         "stdout carries the consensus). Open in Perfetto "
                         "(ui.perfetto.dev) or chrome://tracing")
+    p.add_argument("--metrics", type=str, nargs="?", metavar="FILE",
+                   default=None, const="",
+                   help="maintain a Prometheus text-exposition file "
+                        "(atomic renames, ~1s refresh) while the run "
+                        "executes — the feed for `abpoa-tpu top` and any "
+                        "node_exporter textfile collector "
+                        "[FILE defaults to ~/.cache/abpoa_tpu/metrics.prom]")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="N",
+                   help="additionally serve /metrics on 127.0.0.1:N "
+                        "(stdlib http.server) for the duration of the run")
     return p
 
 
@@ -142,18 +153,36 @@ def args_to_params(args: argparse.Namespace) -> Params:
 
 def report_main(argv) -> int:
     """`abpoa-tpu report FILE` — render a `--report` JSON as a one-screen
-    phase/counter/percentile table (tools/report_view.py is the same
-    entry for checkouts without the console script installed)."""
+    phase/counter/percentile table; `abpoa-tpu report --diff A B`
+    compares two reports field by field (delta + percent change).
+    tools/report_view.py is the same entry for checkouts without the
+    console script installed."""
     import json
-    from .obs.report import render_report
+    from .obs.report import render_report, render_report_diff
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: abpoa-tpu report FILE [FILE ...]\n\n"
+        print("usage: abpoa-tpu report FILE [FILE ...]\n"
+              "       abpoa-tpu report --diff A B\n\n"
               "render --report JSON run reports as human-readable tables "
-              "('-' reads stdin)", file=sys.stderr)
+              "('-' reads stdin); --diff compares two reports "
+              "(phase walls, reads/s, CUPS, compiles, faults) with "
+              "per-field delta and percent change", file=sys.stderr)
         return 0 if argv else 1
-    for i, path in enumerate(argv):
+
+    def load(path):
         with (sys.stdin if path == "-" else open(path)) as fp:
-            rep = json.load(fp)
+            return json.load(fp)
+
+    if argv[0] == "--diff":
+        if len(argv) != 3:
+            print("Error: --diff needs exactly two report files.",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_report_diff(load(argv[1]), load(argv[2]),
+                                            label_a=argv[1],
+                                            label_b=argv[2]))
+        return 0
+    for i, path in enumerate(argv):
+        rep = load(path)
         if len(argv) > 1:
             print(("" if i == 0 else "\n") + f"== {path} ==")
         sys.stdout.write(render_report(rep))
@@ -222,14 +251,64 @@ def main(argv=None) -> int:
         return report_main(raw[1:])
     if raw[:1] == ["warm"]:
         return warm_main(raw[1:])
+    if raw[:1] == ["slo"]:
+        from .obs.slo import slo_main
+        return slo_main(raw[1:])
+    if raw[:1] == ["top"]:
+        from .obs.top import top_main
+        return top_main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.input is None:
         build_parser().print_help(sys.stderr)
         return 1
-    abpt = args_to_params(args).finalize()
-    from .utils import set_verbose, run_stats
+    try:
+        abpt = args_to_params(args).finalize()
+    except ValueError as e:
+        # parameter-contract violations (negative scores, the -E>=64
+        # convex-gap bound, ...) are structured one-line errors, never
+        # tracebacks — same contract as malformed input
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     from . import obs
     obs.start_run()
+    metrics_path = http_srv = None
+    try:
+        # exporter startup INSIDE the try: if the HTTP bind fails after
+        # the flusher already started, the finally still tears the
+        # flusher down; startup failures (unwritable path, EADDRINUSE)
+        # are the same structured one-line contract as bad parameters
+        try:
+            if args.metrics is not None:
+                metrics_path = (args.metrics
+                                or obs.metrics.default_textfile_path())
+                os.makedirs(os.path.dirname(metrics_path) or ".",
+                            exist_ok=True)
+                obs.metrics.start_textfile_exporter(metrics_path)
+            if args.metrics_port is not None:
+                http_srv = obs.metrics.start_http_exporter(
+                    args.metrics_port)
+        except OSError as e:
+            print(f"Error: metrics exporter: {e}", file=sys.stderr)
+            return 1
+        return _main_run(args, abpt, argv)
+    finally:
+        # exporter lifecycle must survive ANY mid-run exception (missing
+        # -l list file, unwritable --report path, ...): a leaked flusher
+        # thread would rewrite the textfile forever and a still-bound
+        # --metrics-port would fail the retry with EADDRINUSE
+        if metrics_path is not None:
+            # final frame carries the finished run's gauges (breaker
+            # state included: the breaker resets on the NEXT start_run)
+            obs.metrics.stop_textfile_exporter()
+        if http_srv is not None:
+            http_srv.shutdown()
+
+
+def _main_run(args, abpt, argv) -> int:
+    """The alignment run proper (split from main() so the exporter
+    teardown wraps it in one try/finally)."""
+    from .utils import set_verbose, run_stats
+    from . import obs
     if args.trace:
         obs.trace_enable()
     if args.profile_dir:
@@ -275,13 +354,19 @@ def main(argv=None) -> int:
         if out_fp is not sys.stdout:
             out_fp.close()
     print(f"[abpoa_tpu::main] {run_stats(t0, c0)}", file=sys.stderr)
+    rep = obs.finalize_report()
     if args.report:
         if args.report == "-" and out_fp is sys.stdout:
             # consensus already owns stdout; appending JSON would corrupt
             # the FASTA stream, so the report goes to stderr instead
-            obs.write_report("-", fp=sys.stderr)
+            obs.write_report("-", rep=rep, fp=sys.stderr)
         else:
-            obs.write_report(args.report)
+            obs.write_report(args.report, rep=rep)
+    # cross-run archive (obs/archive.py): one compact JSONL record per
+    # run, the window `abpoa-tpu slo` evaluates. Disabled by
+    # ABPOA_TPU_ARCHIVE=0; failure to archive never fails the run.
+    obs.archive.append_report(rep, label=args.input or "",
+                              device=abpt.device)
     if args.trace:
         meta = {"input": args.input, "device": abpt.device}
         if args.trace == "-" and out_fp is sys.stdout:

@@ -66,6 +66,15 @@ DEFAULT_MMW = 10
 DEFAULT_MIN_POA_WIN = 500
 MULTIP_MIN_FREQ = 0.25
 
+# supported gap-extension range: penalties must stay BELOW this bound.
+# At -E>=64 (a gap column costing 32x a match) the reference binary
+# crashes outright ("Error in lg_backtrack", abpoa_align_simd.c:116-194)
+# and our native engine and the numpy oracle disagree on the optimal
+# alignment (measured boundary: parity through 63, divergence from 64 —
+# PERF.md round 10). The contract is therefore an explicit validation
+# error, not a silent superset: Params.finalize() rejects the config.
+MAX_GAP_EXT = 64
+
 # backtrack op bitmask (abpoa_align.h:20-27)
 M_OP = 0x1
 E1_OP = 0x2

@@ -1,18 +1,23 @@
 """Run-telemetry subsystem: structured phase timers, counters, JSON run
 reports (versioned schema), an MFU model, per-read tail-latency records,
 a hierarchical span tracer (Chrome trace-event export, Perfetto-viewable),
-a compile log for the jitted entry points, and on-chip profiler capture
-hooks. See report.py for the schema, trace.py for the timeline contract,
-compile_log.py for compile detection, mfu.py for the model's assumptions,
-capture.py for the `--profile-dir` hooks; README "Run telemetry" and
-PERF.md document the consumer side (bench.py, perf_gate, chip_watcher)."""
-from . import trace
+a compile log for the jitted entry points, on-chip profiler capture
+hooks — and, above the per-run layer, the fleet-grade metric registry
+(metrics.py: streaming-quantile sketches, Prometheus exposition), the
+cross-run report archive (archive.py) and SLO/error-budget evaluation
+(slo.py, `abpoa-tpu slo`) plus the live `abpoa-tpu top` dashboard
+(top.py). See report.py for the schema, trace.py for the timeline
+contract, compile_log.py for compile detection, mfu.py for the model's
+assumptions, capture.py for the `--profile-dir` hooks; README
+"Run telemetry" / "Metrics & SLOs" and PERF.md document the consumer
+side (bench.py, perf_gate, chip_watcher, CI metrics-smoke)."""
+from . import archive, metrics, trace
 from .capture import device_capture, profile_dir, set_profile_dir
 from .compile_log import compile_watch
 from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
                      finalize_report, observe, phase, record_dp, record_fault,
-                     record_read, report, set_enabled, start_run, summary,
-                     write_report)
+                     record_read, render_report, render_report_diff, report,
+                     set_enabled, start_run, summary, write_report)
 from .trace import (export_chrome_trace, instant, span, span_totals, tracer)
 from .trace import disable as trace_disable
 from .trace import enable as trace_enable
@@ -23,8 +28,10 @@ __all__ = [
     "count", "observe", "phase", "record_dp", "record_fault", "record_read",
     "report",
     "start_run", "set_enabled", "finalize_report", "write_report", "summary",
+    "render_report", "render_report_diff",
     "device_capture", "profile_dir", "set_profile_dir",
     "trace", "trace_enable", "trace_disable", "trace_enabled",
     "span", "instant", "span_totals", "export_chrome_trace", "tracer",
     "compile_watch",
+    "archive", "metrics",
 ]

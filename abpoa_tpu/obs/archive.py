@@ -1,0 +1,128 @@
+"""Run-report archive: the cross-run memory behind `abpoa-tpu slo`.
+
+Each CLI run appends one compact JSONL record (the SLO-relevant slice of
+its RunReport — wall, read percentiles, fallback/recompile/fault counts)
+to ``~/.cache/abpoa_tpu/reports/reports.jsonl``. The archive is what
+turns per-run telemetry into fleet questions: "what was our fallback
+rate across the last 500 runs", "has warm p99 drifted this week" —
+the sustained-workload reporting SeGraM / AnySeq-style evaluations use
+instead of single cold runs.
+
+Growth is bounded: past ``ABPOA_TPU_ARCHIVE_MAX_MB`` (default 8 MB,
+~20k records) the live file rotates to ``reports.jsonl.1`` (one rotated
+generation kept), so a long-lived host caps at ~2x the limit.
+``ABPOA_TPU_ARCHIVE=0`` disables archiving; ``ABPOA_TPU_ARCHIVE_DIR``
+redirects it (CI smoke keeps its archive inside the workspace).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+ARCHIVE_FILE = "reports.jsonl"
+
+
+def archive_enabled() -> bool:
+    return os.environ.get("ABPOA_TPU_ARCHIVE", "1") not in ("0", "off")
+
+
+def archive_dir() -> str:
+    d = os.environ.get("ABPOA_TPU_ARCHIVE_DIR")
+    if d:
+        return d
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(base, "abpoa_tpu", "reports")
+
+
+def archive_path() -> str:
+    return os.path.join(archive_dir(), ARCHIVE_FILE)
+
+
+def max_bytes() -> int:
+    return int(float(os.environ.get("ABPOA_TPU_ARCHIVE_MAX_MB", "8")) * 1e6)
+
+
+def summarize_report(rep: dict, label: str = "",
+                     device: str = "") -> dict:
+    """One archive record from a finalized run report: the fields the SLO
+    objectives evaluate, nothing that grows with the run."""
+    reads = rep.get("reads") or {}
+    comp = rep.get("compiles") or {}
+    faults = rep.get("faults") or {}
+    counters = rep.get("counters") or {}
+    mfu = rep.get("mfu") or {}
+    n_reads = reads.get("count") or 0
+    total = rep.get("total_wall_s") or 0.0
+    fallback_reads = sum((reads.get("fallbacks") or {}).values())
+    return {
+        "ts": rep.get("created") or time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+        "schema_version": rep.get("schema_version"),
+        "label": label,
+        "device": device,
+        "total_wall_s": total,
+        "reads": n_reads,
+        "reads_per_sec": round(n_reads / total, 3) if total else None,
+        "read_wall_ms": reads.get("wall_ms"),
+        "fallback_reads": fallback_reads,
+        "compile_hits": comp.get("hits", 0),
+        "compile_misses": comp.get("misses", 0),
+        "faults": faults.get("count", 0),
+        "quarantined": counters.get("quarantine.sets", 0),
+        "degraded": sorted(rep.get("degraded") or {}),
+        "dp_cells": counters.get("dp.cells", 0),
+        "cell_updates_per_sec": mfu.get("cell_updates_per_sec"),
+        "mfu": mfu.get("mfu"),
+    }
+
+
+def append_report(rep: dict, label: str = "", device: str = "") -> Optional[str]:
+    """Archive one finalized run report; returns the record path (None
+    when archiving is disabled or the directory is unwritable — archive
+    failure must never fail the run that produced the report)."""
+    if not archive_enabled():
+        return None
+    rec = summarize_report(rep, label=label, device=device)
+    path = archive_path()
+    try:
+        os.makedirs(archive_dir(), exist_ok=True)
+        with open(path, "a") as fp:
+            fp.write(json.dumps(rec) + "\n")
+        _rotate_if_needed(path)
+    except OSError:
+        return None
+    return path
+
+
+def _rotate_if_needed(path: str) -> None:
+    try:
+        if os.path.getsize(path) <= max_bytes():
+            return
+        os.replace(path, path + ".1")  # drops any previous .1
+    except OSError:
+        pass
+
+
+def read_window(n: int, path: Optional[str] = None) -> List[dict]:
+    """The newest `n` archive records, oldest-first (rotated generation
+    included so a window survives a rotation boundary). Unparseable lines
+    (a crash mid-append) are skipped, never fatal."""
+    path = path or archive_path()
+    lines: List[str] = []
+    for p in (path + ".1", path):
+        try:
+            with open(p) as fp:
+                lines.extend(fp.read().splitlines())
+        except OSError:
+            continue
+    out: List[dict] = []
+    for line in lines[-n:] if n else lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out

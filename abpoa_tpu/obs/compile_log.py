@@ -150,6 +150,13 @@ def compile_watch(name: str, jfn, bucket: dict) -> Iterator[dict]:
                                        (True if hits_d > 0 else None))
         rec["xla_compile_s"] = round(xla_s, 6) if xla_s > 0 else None
         count("compile.misses")
+        if xla_s > 0:
+            from . import metrics
+            if metrics.enabled():
+                metrics.registry().counter(
+                    "abpoa_xla_compile_seconds_total",
+                    "Wall seconds spent inside XLA backend_compile").inc(
+                    round(xla_s, 6))
         from . import trace
         trace.add_span("compile:" + name, "compile", t0, dt,
                        args=dict(bucket))

@@ -1,0 +1,710 @@
+"""Fleet-grade metric registry: typed metrics + Prometheus exposition.
+
+Everything the RunReport (report.py) knows dies with the run; this module
+is the long-lived layer a persistent `abpoa-tpu serve` process (ROADMAP
+item 1) reports itself through: process-cumulative counters, gauges, and
+streaming-quantile histograms, rendered in the Prometheus text exposition
+format — either as a textfile exporter (`--metrics FILE`, atomic rename,
+node_exporter-compatible) or over a stdlib-only HTTP endpoint
+(`--metrics-port N`).
+
+Three metric types:
+
+- Counter: monotonic totals, labeled (`abpoa_reads_total{backend="jax"}`).
+- Gauge: last-written values (`abpoa_breaker_open{backend="jax"}`).
+- Histogram: a bounded log-bucket sketch (`LogSketch`) — fixed geometric
+  buckets over [LO, HI), so p50/p95/p99 over millions of observations
+  cost O(1) memory and stay within a declared relative error
+  (`LogSketch.RELATIVE_ERROR`), unlike the old capped-list percentile
+  path that silently lied past READS_CAP. Sketches are mergeable
+  (bucket-wise addition), the property cross-run aggregation needs.
+
+Publication: obs/report.py mirrors its hot-path hooks here (counter
+names -> labeled Prometheus families via `publish_counter`, phase exits
+via `publish_phase`, per-read records via `publish_read`); resilience/
+publishes breaker state directly. Every publication is a host-side dict
+or array update — the obs overhead contract (no device syncs, no
+allocation beyond the bucket array) holds; `ABPOA_TPU_METRICS=0` or
+`set_enabled(False)` is the A/B kill switch.
+
+Rates (reads/s, cell-updates/s, MFU) are computed at render time from
+counter deltas between consecutive renders, so a periodic exporter
+(`start_textfile_exporter`) yields live gauges the `abpoa-tpu top`
+dashboard can poll.
+"""
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+_ENABLED = os.environ.get("ABPOA_TPU_METRICS", "1") not in ("0", "off")
+
+NAMESPACE = "abpoa"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> None:
+    """Publication kill switch (the overhead guard's control arm)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+# --------------------------------------------------------------------------- #
+# streaming-quantile sketch                                                   #
+# --------------------------------------------------------------------------- #
+
+class LogSketch:
+    """Fixed-bucket log histogram over (LO, HI): a bounded, mergeable
+    quantile sketch.
+
+    Bucket i covers [LO*G^i, LO*G^(i+1)); a quantile query walks the
+    cumulative counts and answers the geometric midpoint of the target
+    bucket, clamped to the exact observed [min, max]. Worst-case relative
+    error is sqrt(G)-1 (~2.5% at G=1.05) for in-range values — declared
+    as RELATIVE_ERROR with margin. Out-of-range values clamp into the
+    edge buckets but min/max stay exact, so the clamp keeps even those
+    honest at the distribution edges.
+    """
+
+    LO = 1e-6          # 1 microsecond
+    HI = 1e4           # ~2.8 hours
+    GROWTH = 1.05
+    N_BUCKETS = int(math.ceil(math.log(HI / LO) / math.log(GROWTH)))  # ~472
+    RELATIVE_ERROR = 0.05
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    _LOG_G = math.log(GROWTH)
+    _LOG_LO = math.log(LO)
+
+    def __init__(self) -> None:
+        self.counts = [0] * self.N_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        self.count += 1
+        self.sum += v
+        if v <= self.LO:
+            i = 0
+        else:
+            i = int((math.log(v) - self._LOG_LO) / self._LOG_G)
+            if i >= self.N_BUCKETS:
+                i = self.N_BUCKETS - 1
+        self.counts[i] += 1
+
+    def merge(self, other: "LogSketch") -> None:
+        """Bucket-wise merge (cross-run / cross-shard aggregation)."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile estimate within RELATIVE_ERROR."""
+        if self.count == 0:
+            return None
+        rank = max(1, min(self.count, math.ceil(q * self.count)))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= rank:
+                mid = self.LO * self.GROWTH ** (i + 0.5)
+                return min(self.max, max(self.min, mid))
+        return self.max
+
+    def bucket_upper_bounds(self):
+        """(upper_bound_seconds, cumulative_count) for every non-empty
+        bucket — the Prometheus histogram series (cumulative `le`).
+        Snapshots the bucket array first so a concurrent observe() from
+        the run thread cannot produce a non-cumulative series."""
+        out = []
+        acc = 0
+        for i, c in enumerate(list(self.counts)):
+            if c:
+                acc += c
+                out.append((self.LO * self.GROWTH ** (i + 1), acc))
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# metric families                                                             #
+# --------------------------------------------------------------------------- #
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    esc = lambda v: str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")  # noqa: E731
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in labels) + "}"
+
+
+class Counter:
+    TYPE = "counter"
+
+    __slots__ = ("name", "help", "values")
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self.values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self.values[key] = self.values.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self.values.get(tuple(sorted(labels.items())), 0)
+
+    def total(self) -> float:
+        # atomic snapshot first: summing the live view from the exporter
+        # thread would raise if the run thread inserts a key mid-sum
+        return sum(list(self.values.values()))
+
+    def render(self, out: List[str]) -> None:
+        # list() snapshots atomically under the GIL: the exporter thread
+        # renders while the run thread inserts new label keys, and keys
+        # are never deleted, so a snapshot of items is always consistent
+        for key, v in sorted(list(self.values.items())):
+            out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
+
+
+class Gauge(Counter):
+    TYPE = "gauge"
+    __slots__ = ()
+
+    def set(self, v: float, **labels) -> None:
+        self.values[tuple(sorted(labels.items()))] = v
+
+
+class Histogram:
+    """One LogSketch, exposed in the Prometheus histogram format
+    (cumulative `le` buckets + `_sum` + `_count`)."""
+
+    TYPE = "histogram"
+
+    __slots__ = ("name", "help", "sketch")
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self.sketch = LogSketch()
+
+    def observe(self, v: float) -> None:
+        self.sketch.observe(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self.sketch.quantile(q)
+
+    def render(self, out: List[str]) -> None:
+        # +Inf and _count derive from the same bucket snapshot the `le`
+        # series used: a frame rendered mid-observe stays self-consistent
+        # (the lint checks exactly that), at worst one observation stale
+        buckets = self.sketch.bucket_upper_bounds()
+        total = buckets[-1][1] if buckets else 0
+        for ub, acc in buckets:
+            out.append(f'{self.name}_bucket{{le="{ub:.9g}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {_num(self.sketch.sum)}")
+        out.append(f"{self.name}_count {total}")
+
+
+def _num(v: float) -> str:
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+# --------------------------------------------------------------------------- #
+# registry                                                                    #
+# --------------------------------------------------------------------------- #
+
+class MetricsRegistry:
+    """Process-global family store + exposition renderer.
+
+    `collectors` are callbacks run at render time (device identity,
+    trace-drop gauges — values that are cheap to read but wasteful to
+    push on every event). Rate gauges (reads/s, CUPS, MFU) are derived
+    from counter deltas between consecutive renders.
+    """
+
+    def __init__(self) -> None:
+        self._families: Dict[str, object] = {}
+        self._order: List[str] = []
+        self.collectors: List[Callable[["MetricsRegistry"], None]] = []
+        self._lock = threading.Lock()
+        # rate-gauge origin: first render averages over registry lifetime,
+        # later renders over the inter-render interval (live rates)
+        self._prev_rates: Tuple[float, float, float, float] = (
+            time.perf_counter(), 0.0, 0.0, 0.0)
+        self.created = time.time()
+
+    # ------------------------------------------------------------- families
+    def _family(self, cls, name: str, help_: str):
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = cls(name, help_)
+                    self._families[name] = fam
+                    self._order.append(name)
+        return fam
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._family(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._family(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        return self._family(Histogram, name, help_)
+
+    def get(self, name: str):
+        return self._families.get(name)
+
+    def register_collector(self, fn: Callable) -> None:
+        if fn not in self.collectors:
+            self.collectors.append(fn)
+
+    # ------------------------------------------------------------- rendering
+    def _update_rate_gauges(self) -> None:
+        """reads/s, cell-updates/s and MFU from counter deltas between
+        consecutive renders — live gauges for a polling exporter, whole-
+        process averages on a one-shot render."""
+        now = time.perf_counter()
+        reads = _fam_total(self, "abpoa_reads_total")
+        cells = _fam_total(self, "abpoa_dp_cells_total")
+        ops = _fam_total(self, "abpoa_dp_cell_ops_total")
+        prev = self._prev_rates
+        self._prev_rates = (now, reads, cells, ops)
+        dt = now - prev[0]
+        if dt <= 0:
+            return
+        g = self.gauge("abpoa_reads_per_second",
+                       "Read throughput over the last exporter interval")
+        g.set(round((reads - prev[1]) / dt, 3))
+        g = self.gauge("abpoa_cell_updates_per_second",
+                       "DP cell-updates/s over the last exporter interval "
+                       "(the AnySeq/GPU throughput metric)")
+        g.set(round((cells - prev[2]) / dt, 1))
+        peak = _fam_total(self, "abpoa_device_peak_ops_per_second")
+        if peak > 0:
+            g = self.gauge("abpoa_mfu_ratio",
+                           "Model FLOPs utilization estimate over the last "
+                           "exporter interval (DP int-ops vs device peak)")
+            g.set(round((ops - prev[3]) / dt / peak, 6))
+
+    def _update_quantile_gauges(self) -> None:
+        h = self._families.get("abpoa_read_wall_seconds")
+        if h is None or h.sketch.count == 0:
+            return
+        g = self.gauge("abpoa_read_wall_seconds_quantile",
+                       "Sketch-estimated per-read wall quantiles "
+                       "(textfile-exporter convenience for `top`)")
+        for q, label in ((0.5, "0.5"), (0.95, "0.95"), (0.99, "0.99")):
+            g.set(round(h.quantile(q), 9), quantile=label)
+
+    def render(self) -> str:
+        """The Prometheus text exposition (format version 0.0.4)."""
+        for fn in list(_GLOBAL_COLLECTORS) + list(self.collectors):
+            try:
+                fn(self)
+            except Exception:
+                pass
+        self._update_rate_gauges()
+        self._update_quantile_gauges()
+        out: List[str] = []
+        with self._lock:
+            names = list(self._order)
+        for name in names:
+            fam = self._families[name]
+            if fam.help:
+                out.append(f"# HELP {name} {fam.help}")
+            out.append(f"# TYPE {name} {fam.TYPE}")
+            fam.render(out)
+        return "\n".join(out) + "\n"
+
+
+def _fam_total(reg: MetricsRegistry, name: str) -> float:
+    fam = reg.get(name)
+    return fam.total() if isinstance(fam, Counter) else 0.0
+
+
+_REGISTRY = MetricsRegistry()
+
+# collectors that survive reset_registry() (module-lifetime publishers:
+# obs/report.py's device/trace gauges)
+_GLOBAL_COLLECTORS: List[Callable] = []
+
+
+def register_global_collector(fn: Callable) -> None:
+    if fn not in _GLOBAL_COLLECTORS:
+        _GLOBAL_COLLECTORS.append(fn)
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def reset_registry() -> MetricsRegistry:
+    """Fresh registry (tests; a served process never resets)."""
+    global _REGISTRY
+    _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+# --------------------------------------------------------------------------- #
+# publication hooks (called from obs/report.py and resilience/)               #
+# --------------------------------------------------------------------------- #
+
+# RunReport counter name -> (family, help, label name). The run report
+# keeps the full dotted namespace; the registry keeps the curated fleet
+# families. A prefix absent here stays report-only by design.
+_PREFIX_FAMILIES = {
+    "dispatch": ("abpoa_dispatches_total",
+                 "DP kernel dispatches by backend", "backend"),
+    "fallback": ("abpoa_fallbacks_total",
+                 "Degraded-path falls by reason", "reason"),
+    "reroute": ("abpoa_reroutes_total",
+                "Device-ineligible config reroutes by reason", "reason"),
+    "faults": ("abpoa_faults_total",
+               "Absorbed dispatch/input faults by kind", "kind"),
+    "inject": ("abpoa_injected_faults_total",
+               "Fault-injector firings by kind", "kind"),
+}
+
+_EXACT_FAMILIES = {
+    "compile.hits": ("abpoa_compile_hits_total",
+                     "Jit dispatches served from a compile cache"),
+    "compile.misses": ("abpoa_compile_misses_total",
+                       "Jit dispatches that compiled (XLA or persistent-"
+                       "cache load)"),
+    "quarantine.sets": ("abpoa_quarantined_sets_total",
+                        "Read sets quarantined at the -l/batch boundary"),
+    "watchdog.timeouts": ("abpoa_watchdog_fires_total",
+                          "Dispatch watchdog deadline expiries"),
+    "admission.demote": ("abpoa_admission_demotions_total",
+                         "Memory-admission demotions to the host kernel"),
+    "admission.chunk": ("abpoa_admission_chunks_total",
+                        "Memory-admission lockstep group splits"),
+    "breaker.short_circuit": ("abpoa_breaker_short_circuits_total",
+                              "Dispatches short-circuited by an open "
+                              "circuit breaker"),
+    "lockstep.groups": ("abpoa_lockstep_groups_total",
+                        "Lockstep multi-set device dispatch groups"),
+    "dp.dispatches": ("abpoa_dp_dispatches_total", "DP kernel dispatches"),
+    "dp.cells": ("abpoa_dp_cells_total", "DP cells computed"),
+    "dp.cell_ops": ("abpoa_dp_cell_ops_total",
+                    "Estimated integer ops over DP cells (MFU numerator)"),
+}
+
+_BREAKER_PREFIXES = {
+    "breaker.failures": ("abpoa_breaker_failures_total",
+                         "Classified dispatch failures by backend"),
+    "breaker.open": ("abpoa_breaker_opens_total",
+                     "Circuit-breaker open events by backend"),
+}
+
+
+def publish_counter(name: str, n: int) -> None:
+    """Mirror one RunReport counter increment into the fleet registry."""
+    if not _ENABLED:
+        return
+    exact = _EXACT_FAMILIES.get(name)
+    if exact is not None:
+        _REGISTRY.counter(*exact).inc(n)
+        return
+    head, _, rest = name.partition(".")
+    fam = _PREFIX_FAMILIES.get(head)
+    if fam is not None:
+        _REGISTRY.counter(fam[0], fam[1]).inc(n, **{fam[2]: rest})
+        return
+    for pref, (fname, fhelp) in _BREAKER_PREFIXES.items():
+        if name.startswith(pref + "."):
+            _REGISTRY.counter(fname, fhelp).inc(
+                n, backend=name[len(pref) + 1:])
+            return
+
+
+def publish_phase(name: str, wall_s: float) -> None:
+    if _ENABLED:
+        _REGISTRY.counter(
+            "abpoa_phase_wall_seconds_total",
+            "Wall seconds by pipeline phase").inc(wall_s, phase=name)
+
+
+def publish_read(wall_s: float, backend: str,
+                 fallback: Optional[str]) -> None:
+    if not _ENABLED:
+        return
+    _REGISTRY.counter("abpoa_reads_total",
+                      "Reads aligned, by the backend that ran them").inc(
+        1, backend=backend)
+    if fallback:
+        _REGISTRY.counter(
+            "abpoa_read_fallbacks_total",
+            "Reads that ran on a fallback path, by reason").inc(
+            1, reason=fallback)
+    _REGISTRY.histogram(
+        "abpoa_read_wall_seconds",
+        "Per-read wall seconds (log-bucket sketch, "
+        f"~{int(LogSketch.RELATIVE_ERROR * 100)}% quantile tolerance)"
+    ).observe(wall_s)
+
+
+def publish_run_start() -> None:
+    if _ENABLED:
+        _REGISTRY.counter("abpoa_runs_total", "Runs started").inc(1)
+
+
+def set_breaker_state(backend: str, open_: bool) -> None:
+    if _ENABLED:
+        _REGISTRY.gauge(
+            "abpoa_breaker_open",
+            "Circuit-breaker state by backend (1 = open/demoted)").set(
+            1 if open_ else 0, backend=backend)
+
+
+def publish_batch_progress(done: int, total: Optional[int] = None) -> None:
+    """Live -l/msa_batch progress for the `top` dashboard: sets completed
+    vs total in the current batch run. Single definition site — the CLI
+    runner and pyapi.msa_batch both publish through here, with identical
+    semantics (a quarantined set counts as completed: the batch moved
+    past it)."""
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge(
+        "abpoa_batch_sets_done",
+        "Read sets completed in the current -l/batch run").set(done)
+    if total is not None:
+        _REGISTRY.gauge(
+            "abpoa_batch_sets",
+            "Read sets in the current -l/batch run").set(total)
+
+
+def bump_batch_set_done() -> None:
+    """Count one more set as completed in the current batch run. A set
+    is done once it has a final disposition — a result OR a quarantine:
+    the batch moved past it either way. The count lives in the gauge
+    itself, so every caller shares one definition of 'done'."""
+    if not _ENABLED:
+        return
+    g = _REGISTRY.gauge(
+        "abpoa_batch_sets_done",
+        "Read sets completed in the current -l/batch run")
+    g.set(g.value() + 1)
+
+
+def clear_batch_progress() -> None:
+    """Zero the batch gauges at run start so a later non-batch run does
+    not keep exporting the previous batch's progress. Only touches
+    families a batch run already materialized — a process that never ran
+    a batch never exports them at all."""
+    for name in ("abpoa_batch_sets", "abpoa_batch_sets_done"):
+        fam = _REGISTRY.get(name)
+        if fam is not None:
+            fam.set(0)
+
+
+# --------------------------------------------------------------------------- #
+# textfile exporter (atomic) + background flusher                             #
+# --------------------------------------------------------------------------- #
+
+def default_textfile_path() -> str:
+    """Where `--metrics` (no argument) writes and `abpoa-tpu top` (no
+    argument) reads: one well-known handoff point per user."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    return os.path.join(base, "abpoa_tpu", "metrics.prom")
+
+
+def write_textfile(path: str) -> None:
+    """One atomic exposition write (tmp + rename): a scraper or the `top`
+    dashboard never reads a torn file."""
+    text = _REGISTRY.render()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fp:
+        fp.write(text)
+    os.replace(tmp, path)
+
+
+class _Flusher(threading.Thread):
+    def __init__(self, path: str, interval_s: float) -> None:
+        super().__init__(daemon=True, name="abpoa-metrics-flusher")
+        self.path = path
+        self.interval_s = interval_s
+        # NOT `_stop`: Thread.join() calls a private `_stop()` internally
+        self._stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                write_textfile(self.path)
+            except Exception:
+                # a transient render/IO failure must not kill the
+                # exporter for the rest of the run — the next interval
+                # writes a fresh frame
+                pass
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+
+_FLUSHER: Optional[_Flusher] = None
+
+
+def start_textfile_exporter(path: str, interval_s: float = None) -> None:
+    """Periodic atomic exposition writes to `path` (`--metrics FILE`) — the
+    live feed `abpoa-tpu top` renders while a run executes. Host-side
+    rendering only: the flusher reads counters the hot path already
+    maintains, it never touches the device."""
+    global _FLUSHER
+    stop_textfile_exporter()
+    if interval_s is None:
+        interval_s = float(os.environ.get("ABPOA_TPU_METRICS_INTERVAL_S",
+                                          "1.0"))
+    write_textfile(path)  # immediate first frame
+    _FLUSHER = _Flusher(path, interval_s)
+    _FLUSHER.start()
+
+
+def stop_textfile_exporter(final_write: bool = True) -> None:
+    global _FLUSHER
+    if _FLUSHER is not None:
+        _FLUSHER.stop()
+        # join before the final write: both threads use the same tmp
+        # path, so an in-flight flusher write racing the final one could
+        # rename a torn frame into place
+        _FLUSHER.join(timeout=10.0)
+        if final_write:
+            try:
+                write_textfile(_FLUSHER.path)
+            except OSError:
+                pass
+        _FLUSHER = None
+
+
+# --------------------------------------------------------------------------- #
+# stdlib HTTP endpoint                                                        #
+# --------------------------------------------------------------------------- #
+
+def start_http_exporter(port: int, host: str = "127.0.0.1"):
+    """`/metrics` over stdlib http.server in a daemon thread
+    (`--metrics-port N`) — the scrape endpoint the future serve mode
+    exposes. Returns the server (call .shutdown() to stop)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = _REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # scrape spam stays off stderr
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="abpoa-metrics-http").start()
+    return srv
+
+
+# --------------------------------------------------------------------------- #
+# exposition parsing + linting (top dashboard, tests, CI smoke)               #
+# --------------------------------------------------------------------------- #
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^}]*\})?'
+    r'\s+(?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|Inf|NaN))\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """-> (samples, types): samples maps (name, labels-frozenset) -> float,
+    types maps family name -> declared TYPE. The reader `abpoa-tpu top`
+    and the lint below share."""
+    samples: Dict[Tuple[str, frozenset], float] = {}
+    types: Dict[str, str] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {ln}: unparseable sample: {line!r}")
+        labels = frozenset(_LABEL_RE.findall(m.group("labels") or ""))
+        samples[(m.group("name"), labels)] = float(m.group("value"))
+    return samples, types
+
+
+def sample_value(samples, name: str, **labels) -> Optional[float]:
+    return samples.get((name, frozenset((k, str(v))
+                                        for k, v in labels.items())))
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Structural lint of a Prometheus text exposition: every sample's
+    family has a TYPE, counters end in _total, histograms carry a +Inf
+    bucket with consistent _count, gauges/counters parse as numbers.
+    Returns problems (empty = clean). CI's metrics-smoke gate."""
+    problems: List[str] = []
+    try:
+        samples, types = parse_exposition(text)
+    except ValueError as e:
+        return [str(e)]
+    hist_bases = {n for n, t in types.items() if t == "histogram"}
+    for (name, labels), _v in samples.items():
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in hist_bases:
+                base = name[:-len(suffix)]
+        if base not in types:
+            problems.append(f"{name}: sample without a # TYPE declaration")
+        elif types[base] == "counter" and not name.endswith("_total"):
+            problems.append(f"{name}: counter family without _total suffix")
+    for base in hist_bases:
+        inf = sample_value(samples, base + "_bucket", le="+Inf")
+        cnt = samples.get((base + "_count", frozenset()))
+        if inf is None:
+            problems.append(f"{base}: histogram without a +Inf bucket")
+        elif cnt is not None and inf != cnt:
+            problems.append(f"{base}: +Inf bucket {inf} != _count {cnt}")
+        buckets = sorted(
+            (float(dict(lb)["le"]), v)
+            for (n, lb), v in samples.items()
+            if n == base + "_bucket" and dict(lb).get("le", "+Inf") != "+Inf")
+        last = 0.0
+        for ub, v in buckets:
+            if v < last:
+                problems.append(f"{base}: non-cumulative bucket le={ub}")
+            last = v
+    return problems

@@ -18,15 +18,17 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
 import sys
 import time
 from typing import Dict, Iterator, Optional
 
 from . import compile_log as _clog
+from . import metrics as _metrics
 from . import trace as _trace
 
 SCHEMA = "abpoa-tpu-run-report"
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # top-level keys of the rendered report, in schema order. Goldened by
 # tests/test_obs.py: adding a key is a SCHEMA_VERSION bump.
@@ -35,12 +37,20 @@ SCHEMA_VERSION = 3
 # v3 adds `faults` (every absorbed dispatch failure / quarantined set,
 # abpoa_tpu/resilience) and `degraded` (circuit-breaker demotions active
 # at the end of the run) — a clean run carries null for both.
+# v4 re-bases the `reads` block on the streaming log-bucket sketch
+# (obs/metrics.py LogSketch): `count`/`wall_ms`/`backends`/`fallbacks`
+# now cover EVERY read — honest p50/p95/p99 past READS_CAP in O(1)
+# memory — while raw records (bounded by READS_CAP, `records_kept`) feed
+# only the qlen/band attribution tables.
 SCHEMA_KEYS = ("schema", "schema_version", "created", "total_wall_s",
                "phase_wall_sum_s", "phases", "counters", "values",
                "reads", "compiles", "faults", "degraded", "device", "mfu")
 
-# per-read record bound: percentiles over a truncated stream would lie,
-# so past the cap records are dropped AND counted (`reads.dropped`)
+# raw per-read record bound. Since v4 this caps only the attribution
+# tables (qlen/band extents): the wall percentiles come from the sketch,
+# which sees every read, so they stay honest for a long-lived process
+# streaming millions of reads. Records past the cap are still counted
+# (`reads.dropped`).
 READS_CAP = 100_000
 
 # fault-record bound (same contract as READS_CAP): a fault storm must not
@@ -52,8 +62,9 @@ class RunReport:
     """Phase timers + counters + value summaries for one run."""
 
     __slots__ = ("enabled", "t_start", "phases", "counters", "values",
-                 "reads", "reads_dropped", "faults", "faults_dropped",
-                 "degraded")
+                 "reads", "reads_dropped", "wall_sketch", "read_backends",
+                 "read_fallbacks", "reads_amortized", "faults",
+                 "faults_dropped", "degraded")
 
     def __init__(self) -> None:
         self.enabled = True
@@ -67,6 +78,13 @@ class RunReport:
         # (wall_s, qlen, band_cols, backend, fallback, amortized)
         self.reads: list = []
         self.reads_dropped = 0
+        # v4: the percentile path — a bounded mergeable sketch over EVERY
+        # read's wall, plus exact O(1) attribution dicts; the raw list
+        # above only feeds the qlen/band tables
+        self.wall_sketch = _metrics.LogSketch()
+        self.read_backends: Dict[str, int] = {}
+        self.read_fallbacks: Dict[str, int] = {}
+        self.reads_amortized = 0
         # absorbed failures (resilience layer): dicts, FAULTS_CAP-bounded
         self.faults: list = []
         self.faults_dropped = 0
@@ -96,10 +114,14 @@ class RunReport:
                 rec[0] += dt
                 rec[1] += 1
             _trace.add_span(name, "phase", t0, dt)
+            _metrics.publish_phase(name, dt)
 
     def count(self, name: str, n: int = 1) -> None:
         if self.enabled:
             self.counters[name] = self.counters.get(name, 0) + n
+            # mirror into the process-cumulative fleet registry (curated
+            # Prometheus families; names outside the map stay run-local)
+            _metrics.publish_counter(name, n)
 
     def observe(self, name: str, value: float) -> None:
         """Value summary (count/sum/min/max) — a histogram's moments without
@@ -148,6 +170,16 @@ class RunReport:
         number is then a share, not an independent measurement."""
         if not self.enabled:
             return
+        # the sketch and the attribution dicts see EVERY read (O(1) each);
+        # only the raw record list is capped
+        self.wall_sketch.observe(wall_s)
+        self.read_backends[backend] = self.read_backends.get(backend, 0) + 1
+        if fallback:
+            self.read_fallbacks[fallback] = \
+                self.read_fallbacks.get(fallback, 0) + 1
+        if amortized:
+            self.reads_amortized += 1
+        _metrics.publish_read(wall_s, backend, fallback)
         if len(self.reads) < READS_CAP:
             self.reads.append((wall_s, qlen, band_cols, backend, fallback,
                                amortized))
@@ -203,43 +235,44 @@ class RunReport:
         }
 
     def _reads_block(self) -> Optional[dict]:
-        """Tail-latency aggregation of the per-read records: nearest-rank
-        p50/p95/p99 over wall, plus backend/fallback attribution."""
-        if not self.reads and not self.reads_dropped:
+        """Tail-latency aggregation of the per-read stream (schema v4):
+        `count`, `wall_ms` percentiles, `backends`/`fallbacks` cover every
+        read via the streaming sketch + O(1) dicts; the qlen/band
+        attribution tables come from the raw records (READS_CAP-bounded,
+        `records_kept`/`dropped`)."""
+        sk = self.wall_sketch
+        if sk.count == 0:
             return None
-        walls = sorted(r[0] for r in self.reads)
+        n = sk.count
         qlens = [r[1] for r in self.reads]
         bands = [r[2] for r in self.reads]
-        backends: Dict[str, int] = {}
-        fallbacks: Dict[str, int] = {}
-        amortized = 0
-        for _w, _q, _b, backend, fb, am in self.reads:
-            backends[backend] = backends.get(backend, 0) + 1
-            if fb:
-                fallbacks[fb] = fallbacks.get(fb, 0) + 1
-            if am:
-                amortized += 1
-        n = len(walls)
+        nk = len(self.reads)
 
         def ms(x):
-            return round(x * 1e3, 4)
+            return round(x * 1e3, 4) if x is not None else None
 
         return {
             "count": n,
+            "records_kept": nk,
             "dropped": self.reads_dropped,
-            "amortized": amortized,
-            "backends": dict(sorted(backends.items())),
-            "fallbacks": dict(sorted(fallbacks.items())),
+            "amortized": self.reads_amortized,
+            "backends": dict(sorted(self.read_backends.items())),
+            "fallbacks": dict(sorted(self.read_fallbacks.items())),
             "wall_ms": {
-                "p50": ms(_percentile(walls, 0.50)),
-                "p95": ms(_percentile(walls, 0.95)),
-                "p99": ms(_percentile(walls, 0.99)),
-                "mean": ms(sum(walls) / n) if n else None,
-                "max": ms(walls[-1]) if n else None,
+                "p50": ms(sk.quantile(0.50)),
+                "p95": ms(sk.quantile(0.95)),
+                "p99": ms(sk.quantile(0.99)),
+                "mean": ms(sk.sum / n),
+                "max": ms(sk.max),
             },
+            # sketch provenance: a reader can tell these percentiles carry
+            # a declared tolerance instead of nearest-rank exactness
+            "sketch": {"kind": "log-bucket",
+                       "relative_error": sk.RELATIVE_ERROR},
             "qlen": {"min": min(qlens), "max": max(qlens),
-                     "mean": round(sum(qlens) / n, 1)} if n else None,
-            "band_cols": {"min": min(bands), "max": max(bands)} if n else None,
+                     "mean": round(sum(qlens) / nk, 1)} if nk else None,
+            "band_cols": {"min": min(bands),
+                          "max": max(bands)} if nk else None,
         }
 
     @staticmethod
@@ -290,9 +323,10 @@ class RunReport:
         return rep
 
 
-def _percentile(sorted_vals, q: float):
-    """Nearest-rank percentile over an ascending list (no interpolation:
-    a reported p99 is a latency some real read actually paid)."""
+def exact_percentile(sorted_vals, q: float):
+    """Nearest-rank percentile over an ascending list (no interpolation).
+    The sketch-tolerance tests use this as the exact reference the
+    LogSketch estimates are judged against."""
     if not sorted_vals:
         return None
     i = max(0, min(len(sorted_vals) - 1,
@@ -322,6 +356,29 @@ def _device_info() -> Optional[dict]:
 _REPORT = RunReport()
 
 
+def _metrics_collector(reg) -> None:
+    """Render-time gauges too cheap to bother pushing per event: the trace
+    ring's drop count and the device identity + MFU peak (readable only
+    once a device path made jax live — _device_info never imports jax)."""
+    reg.gauge("abpoa_trace_dropped_events",
+              "Trace ring-buffer events overwritten before export").set(
+        _trace.tracer().dropped)
+    dev = _device_info()
+    if dev:
+        reg.gauge("abpoa_device_info",
+                  "Accelerator identity (value is always 1)").set(
+            1, platform=dev.get("platform", ""), kind=dev.get("kind", ""))
+        from .mfu import peak_ops_for_kind
+        peak = peak_ops_for_kind(dev.get("kind") or "")
+        if peak:
+            reg.gauge("abpoa_device_peak_ops_per_second",
+                      "Peak int-op throughput of the attached device "
+                      "(MFU denominator)").set(peak)
+
+
+_metrics.register_global_collector(_metrics_collector)
+
+
 def report() -> RunReport:
     return _REPORT
 
@@ -329,6 +386,9 @@ def report() -> RunReport:
 def start_run() -> None:
     """Reset the global report; call at the top of each CLI/pyapi run."""
     _REPORT.reset()
+    _metrics.publish_run_start()
+    # run-scoped gauges must not outlive their run in the exposition
+    _metrics.clear_batch_progress()
     # backend-resolution state is process-global too; a new run must not
     # inherit the previous run's resolved kernel as a telemetry label
     try:
@@ -463,12 +523,16 @@ def render_report(rep: dict) -> str:
         wm = reads["wall_ms"]
         lines.append("")
         lines.append(f"reads: {reads['count']:,}"
-                     + (f" (+{reads['dropped']:,} dropped)"
+                     + (f" (qlen/band tables over the first "
+                        f"{reads.get('records_kept', 0):,} records)"
                         if reads.get("dropped") else "")
                      + (f", {reads['amortized']:,} amortized"
                         if reads.get("amortized") else ""))
+        sk = reads.get("sketch")
+        tol = (f" (sketch, ±{100 * sk['relative_error']:.0f}%)"
+               if sk else "")
         lines.append(f"  wall ms  p50 {wm['p50']}  p95 {wm['p95']}  "
-                     f"p99 {wm['p99']}  max {wm['max']}")
+                     f"p99 {wm['p99']}  max {wm['max']}{tol}")
         if reads.get("backends"):
             lines.append("  backends: " + "  ".join(
                 f"{k}={v}" for k, v in reads["backends"].items()))
@@ -525,4 +589,78 @@ def render_report(rep: dict) -> str:
         lines.append("counters:")
         for name, v in sorted(counters.items()):
             lines.append(f"  {name:<28} {v:,}")
+    return "\n".join(lines) + "\n"
+
+
+def _diff_rows(rep: dict) -> dict:
+    """The comparable scalar slice of a run report (either schema
+    direction: v2+ reports all carry these or render as n/a)."""
+    reads = rep.get("reads") or {}
+    wall_ms = reads.get("wall_ms") or {}
+    comp = rep.get("compiles") or {}
+    mfu = rep.get("mfu") or {}
+    counters = rep.get("counters") or {}
+    total = rep.get("total_wall_s") or 0.0
+    n_reads = reads.get("count") or 0
+    rows = {"total_wall_s": total,
+            "reads": n_reads,
+            "reads_per_sec": (n_reads / total) if total and n_reads
+            else None,
+            "read_p50_ms": wall_ms.get("p50"),
+            "read_p99_ms": wall_ms.get("p99"),
+            "cell_updates_per_sec": mfu.get("cell_updates_per_sec"),
+            "dp_cells": counters.get("dp.cells"),
+            "compile_misses": comp.get("misses"),
+            "compile_hits": comp.get("hits"),
+            "faults": (rep.get("faults") or {}).get("count", 0),
+            "quarantined_sets": counters.get("quarantine.sets", 0)}
+    for name, ph in sorted((rep.get("phases") or {}).items()):
+        rows[f"phase.{name}_s"] = ph.get("wall_s")
+    return rows
+
+
+# fields where bigger is better (delta coloring of the diff): everything
+# else is a cost
+_DIFF_HIGHER_BETTER = {"reads_per_sec", "cell_updates_per_sec",
+                       "compile_hits"}
+
+
+def render_report_diff(rep_a: dict, rep_b: dict,
+                       label_a: str = "A", label_b: str = "B") -> str:
+    """`abpoa-tpu report --diff A B`: side-by-side per-field comparison
+    of two run reports (phase walls, reads/s, CUPS, compiles, faults)
+    with absolute delta and percent change — the manual perf-triage loop
+    without eyeballing two JSON blobs."""
+    rows_a, rows_b = _diff_rows(rep_a), _diff_rows(rep_b)
+    names = list(rows_a)
+    names.extend(k for k in rows_b if k not in rows_a)
+    la = (os.path.basename(label_a) or label_a)[:16]
+    lb = (os.path.basename(label_b) or label_b)[:16]
+    lines = [f"report diff: A={label_a} (schema "
+             f"v{rep_a.get('schema_version')})  B={label_b} (schema "
+             f"v{rep_b.get('schema_version')})",
+             f"  {'field':<22} {la:>14} {lb:>14} {'delta':>12} "
+             f"{'change':>8}"]
+
+    def fmt(v):
+        if v is None:
+            return "n/a"
+        if isinstance(v, float):
+            return f"{v:,.4g}" if abs(v) < 1e6 else f"{v:,.0f}"
+        return f"{v:,}"
+
+    for name in names:
+        va, vb = rows_a.get(name), rows_b.get(name)
+        if va is None and vb is None:
+            continue
+        if va is None or vb is None:
+            delta = pct = mark = ""
+        else:
+            d = vb - va
+            delta = f"{d:+,.4g}" if isinstance(d, float) else f"{d:+,}"
+            pct = f"{100.0 * d / va:+.1f}%" if va else ""
+            better = (d > 0) == (name in _DIFF_HIGHER_BETTER)
+            mark = "" if d == 0 else ("  +" if better else "  -")
+        lines.append(f"  {name:<22} {fmt(va):>14} {fmt(vb):>14} "
+                     f"{delta:>12} {pct:>8}{mark}")
     return "\n".join(lines) + "\n"

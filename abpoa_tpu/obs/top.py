@@ -1,0 +1,182 @@
+"""`abpoa-tpu top` — a live terminal dashboard over the metrics exporter.
+
+Reads the Prometheus textfile a concurrent run maintains (`--metrics
+FILE`, atomic renames, so a frame is never torn) and renders the
+operator's one-screen view: reads/s, cell-updates/s, MFU, the phase
+split, breaker states, compile hits/misses, fault and fallback counters.
+Plain-refresh rendering (ANSI home+clear per frame) — no curses
+dependency, works over ssh and in CI transcripts; `--once` prints a
+single frame and exits (the testable path).
+
+    terminal 1:  abpoa-tpu -l lists.txt --metrics /tmp/abpoa.prom
+    terminal 2:  abpoa-tpu top /tmp/abpoa.prom
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, Tuple
+
+from . import metrics as M
+
+BAR_W = 24
+
+
+def _labeled(samples, family: str, label: str) -> Dict[str, float]:
+    """{label-value: sample} for every sample of `family` keyed by one
+    label name."""
+    out: Dict[str, float] = {}
+    for (name, labels), v in samples.items():
+        if name == family:
+            d = dict(labels)
+            if label in d:
+                out[d[label]] = v
+    return out
+
+
+def _total(samples, family: str) -> float:
+    return sum(v for (name, _l), v in samples.items() if name == family)
+
+
+def _bar(frac: float, width: int = BAR_W) -> str:
+    n = int(round(max(0.0, min(1.0, frac)) * width))
+    return "#" * n + "." * (width - n)
+
+
+def _fmt_si(v: float) -> str:
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suf}"
+    return f"{v:.0f}"
+
+
+def render_frame(samples, types, path: str, age_s: float) -> str:
+    """One dashboard frame from parsed exposition samples."""
+    lines = []
+    staleness = " [STALE]" if age_s > 10 else ""
+    lines.append(f"abpoa-tpu top — {path}  (updated {age_s:.1f}s ago"
+                 f"{staleness})")
+    runs = _total(samples, "abpoa_runs_total")
+    dev = next((dict(lb) for (n, lb) in samples
+                if n == "abpoa_device_info"), None)
+    devs = (f"  device {dev.get('platform', '?')} {dev.get('kind', '')}"
+            .rstrip() if dev else "")
+    batch = M.sample_value(samples, "abpoa_batch_sets")
+    prog = ""
+    if batch:
+        done = M.sample_value(samples, "abpoa_batch_sets_done") or 0
+        prog = (f"  batch {done:.0f}/{batch:.0f} sets "
+                f"{_bar(done / batch, 12)}")
+    lines.append(f"runs {runs:.0f}{devs}{prog}")
+    lines.append("")
+
+    # throughput block
+    reads = _total(samples, "abpoa_reads_total")
+    rps = M.sample_value(samples, "abpoa_reads_per_second") or 0.0
+    q = {lbl: v for lbl, v in _labeled(
+        samples, "abpoa_read_wall_seconds_quantile", "quantile").items()}
+    lat = ""
+    if q:
+        lat = ("  wall ms  p50 {:.2f}  p95 {:.2f}  p99 {:.2f}".format(
+            1e3 * q.get("0.5", 0), 1e3 * q.get("0.95", 0),
+            1e3 * q.get("0.99", 0)))
+    lines.append(f"reads    {_fmt_si(reads):>9} total  {rps:>9.1f}/s{lat}")
+    cells = _total(samples, "abpoa_dp_cells_total")
+    cups = M.sample_value(samples, "abpoa_cell_updates_per_second") or 0.0
+    mfu = M.sample_value(samples, "abpoa_mfu_ratio")
+    mfu_s = f"  MFU {100 * mfu:.3f}%" if mfu is not None else ""
+    lines.append(f"dp       {_fmt_si(cells):>9} cells  "
+                 f"{_fmt_si(cups):>8}/s CUPS{mfu_s}")
+
+    # phase split
+    phases = _labeled(samples, "abpoa_phase_wall_seconds_total", "phase")
+    tot = sum(phases.values())
+    if tot > 0:
+        lines.append("")
+        lines.append(f"phases   ({tot:.1f}s recorded)")
+        for name, w in sorted(phases.items(), key=lambda kv: -kv[1])[:8]:
+            frac = w / tot
+            lines.append(f"  {name:<16} {_bar(frac)} {100 * frac:>5.1f}% "
+                         f"{w:>8.2f}s")
+
+    # compiles
+    hits = _total(samples, "abpoa_compile_hits_total")
+    misses = _total(samples, "abpoa_compile_misses_total")
+    if hits or misses:
+        xla = _total(samples, "abpoa_xla_compile_seconds_total")
+        xla_s = f"  {xla:.1f}s in XLA" if xla else ""
+        lines.append("")
+        lines.append(f"compiles {misses:.0f} compiled / {hits:.0f} cache "
+                     f"hits{xla_s}")
+
+    # resilience block
+    breakers = _labeled(samples, "abpoa_breaker_open", "backend")
+    if breakers:
+        states = "  ".join(
+            f"{b}={'OPEN' if v else 'closed'}"
+            for b, v in sorted(breakers.items()))
+        lines.append(f"breakers {states}")
+    faults = _labeled(samples, "abpoa_faults_total", "kind")
+    if faults:
+        lines.append("faults   " + "  ".join(
+            f"{k}={v:.0f}" for k, v in sorted(faults.items())))
+    fbs = _labeled(samples, "abpoa_fallbacks_total", "reason")
+    if fbs:
+        lines.append("fallback " + "  ".join(
+            f"{k}={v:.0f}" for k, v in sorted(fbs.items())))
+    extras = []
+    for fam, lbl in (("abpoa_quarantined_sets_total", "quarantined sets"),
+                     ("abpoa_watchdog_fires_total", "watchdog fires"),
+                     ("abpoa_admission_demotions_total",
+                      "admission demotions")):
+        v = _total(samples, fam)
+        if v:
+            extras.append(f"{lbl} {v:.0f}")
+    if extras:
+        lines.append("events   " + "  ".join(extras))
+    return "\n".join(lines) + "\n"
+
+
+def _read_frame(path: str) -> Tuple[str, float]:
+    with open(path) as fp:
+        text = fp.read()
+    age = time.time() - os.path.getmtime(path)
+    return text, age
+
+
+def top_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="abpoa-tpu top",
+        description="live terminal dashboard over the --metrics exporter "
+                    "file of a concurrent run")
+    ap.add_argument("file", nargs="?", default=M.default_textfile_path(),
+                    help="exporter textfile to watch "
+                         "[%(default)s]")
+    ap.add_argument("-n", "--interval", type=float, default=1.0,
+                    help="refresh interval seconds [%(default)s]")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            text, age = _read_frame(args.file)
+            samples, types = M.parse_exposition(text)
+            frame = render_frame(samples, types, args.file, age)
+        except OSError:
+            frame = (f"abpoa-tpu top — waiting for {args.file}\n"
+                     "(start a run with `--metrics "
+                     f"{args.file}` to feed it)\n")
+        except ValueError as e:
+            frame = f"abpoa-tpu top — unparseable exposition: {e}\n"
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        # plain refresh: home + clear-to-end, then the frame
+        sys.stdout.write("\x1b[H\x1b[2J" + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0

@@ -177,10 +177,15 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
     vmapped dispatch, then emitted in file order, so peak memory is one
     group's read sets + graphs — not the whole file list."""
     from .. import resilience as rz
+    from ..obs import metrics as _metrics
     from ..pipeline import Abpoa, msa_from_file, output
     stats = {"sets": len(files), "quarantined": 0}
     if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
         return stats  # mirror msa_from_file: nothing to emit or compute
+    # live batch-progress gauges: `abpoa-tpu top` shows sets done / total
+    # while the -l run executes (the exporter flusher publishes them)
+    _metrics.publish_batch_progress(0, total=len(files))
+    _mark_set_done = _metrics.bump_batch_set_done
     lock = _lockstep_ok(abpt)
     if devices is None:
         if lock or abpt.device in ("jax", "tpu", "pallas"):
@@ -229,6 +234,7 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
         ab = Abpoa()
         for i, fn in enumerate(files):
             run_one_quarantined(ab, i, fn)
+            _mark_set_done()
         return stats
 
     from ..align.eligibility import fused_eligible
@@ -252,6 +258,7 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
                 # ineligible or device-failed: sequential path (re-reads the
                 # file; IO is negligible next to alignment)
                 run_one_quarantined(ab_seq, idx, fn)
+            _mark_set_done()
         seg.clear()
         group.clear()
 
@@ -265,6 +272,7 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
             # per-set quarantine: report this set, keep the batch going
             rz.quarantine_set(i, fn, e)
             stats["quarantined"] += 1
+            _mark_set_done()
             continue
         seg.append((i, fn))
         if fused_eligible(abpt, len(seqs)):

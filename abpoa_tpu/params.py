@@ -134,6 +134,18 @@ class Params:
             raise ValueError("negative scoring parameters")
         if self.gap_ext1 == 0 and self.gap_ext2 == 0:
             raise ValueError("at least one gap extension must be positive")
+        if max(self.gap_ext1, self.gap_ext2) >= C.MAX_GAP_EXT:
+            # the documented -E contract (ROADMAP item 5 / PERF.md round
+            # 10): the reference crashes in this regime (lg_backtrack) and
+            # the in-tree engines diverge from exactly 64 up, so the
+            # config is rejected instead of silently mis-scoring
+            raise ValueError(
+                f"gap extension penalty "
+                f"{max(self.gap_ext1, self.gap_ext2)} is outside the "
+                f"supported range (must be < {C.MAX_GAP_EXT}): the "
+                "reference implementation crashes for -E>=64 and the "
+                "banded engines diverge there; use a smaller extension "
+                "penalty")
         if self.gap_open1 == 0:
             self.gap_mode = C.LINEAR_GAP
         elif self.gap_open2 == 0:

@@ -224,6 +224,9 @@ class msa_aligner:
         if qscores_sets is not None and len(qscores_sets) != len(seq_sets):
             raise ValueError("qscores_sets must contain one entry per set.")
         obs.start_run()
+        # batch-progress gauges (same family the -l runner publishes):
+        # a live `top` over the exporter shows sets done / total
+        obs.metrics.publish_batch_progress(0, total=len(seq_sets))
         self._in_batch = True
         try:
             return self._msa_batch_inner(seq_sets, out_cons, out_msa,
@@ -260,6 +263,7 @@ class msa_aligner:
                 rz.quarantine_set(k, f"set {k}", e)
                 return None
 
+        _mark_set_done = obs.metrics.bump_batch_set_done
         results: List[msa_result] = [None] * len(seq_sets)
         lockstep: List[int] = []
         enc_sets, wgt_sets = [], []
@@ -361,9 +365,11 @@ class msa_aligner:
                     ab.append_read(seq=seq)
                 ab.graph = pg
                 results[k] = self._collect(len(seq_sets[k]), ab=ab)
+                _mark_set_done()
         for k in range(len(seq_sets)):
             if results[k] is None:
                 results[k] = seq_fallback(k)
+                _mark_set_done()
         return results
 
     def msa_align(self, seqs, out_cons, out_msa, max_n_cons=1, min_freq=0.25,

@@ -35,6 +35,11 @@ class CircuitBreaker:
         self.open: Dict[str, dict] = {}   # backend -> {"to", "kind", "failures"}
 
     def reset(self) -> None:
+        # fleet registry: an open breaker from the previous run reads as
+        # closed again the moment the next run starts (run-scoped state)
+        from ..obs import metrics
+        for backend in self.open:
+            metrics.set_breaker_state(backend, False)
         self.failures.clear()
         self.open.clear()
 
@@ -58,6 +63,8 @@ class CircuitBreaker:
             self.open[backend] = {"to": to, "kind": kind, "failures": n}
             count(f"breaker.open.{backend}")
             report().mark_degraded(backend, to, kind, n)
+            from ..obs import metrics
+            metrics.set_breaker_state(backend, True)
             print(f"Warning: backend '{backend}' circuit breaker opened "
                   f"after {n} dispatch failures (last: {kind}); using "
                   f"'{to}' for the remainder of the run.", file=sys.stderr)

@@ -80,9 +80,18 @@ def admit(caps: dict) -> Tuple[str, int, Optional[int]]:
              dispatch in smaller sub-groups (`max_sets_within`)
     "demote" even one set exceeds the budget: run it on the host kernel
     """
-    from ..obs import count
+    from ..obs import count, metrics
     budget = budget_bytes()
     est = estimate_bytes(caps)
+    if metrics.enabled():
+        g = metrics.registry().gauge(
+            "abpoa_admission_last_estimate_bytes",
+            "Device-byte estimate of the most recent admission decision")
+        g.set(est)
+        if budget is not None:
+            metrics.registry().gauge(
+                "abpoa_admission_budget_bytes",
+                "Device-memory admission budget").set(budget)
     if budget is None or est <= budget:
         return "ok", est, budget
     count("admission.over_budget")

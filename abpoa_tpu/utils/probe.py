@@ -260,3 +260,36 @@ def reset_probe_cache() -> None:
     _PROBE_RESULT = None
     _WARNED = False
     _PLATFORMS = None
+
+
+# --------------------------------------------------------------------------- #
+# bounded probe-transition log                                                #
+# --------------------------------------------------------------------------- #
+
+# entry cap for append_jsonl_bounded callers (TPU_PROBE_LOG.jsonl): the
+# watcher probes every 90 s, so an unbounded append-only log grows without
+# limit on a long-lived host. 2000 entries ≈ 2 days of continuous probing
+# — recent history survives, ancient transitions age out.
+_PROBE_LOG_MAX = int(os.environ.get("ABPOA_TPU_PROBE_LOG_MAX", "2000"))
+
+
+def append_jsonl_bounded(path: str, obj: dict,
+                         max_entries: Optional[int] = None) -> None:
+    """Append one JSON line to `path`, keeping only the newest
+    `max_entries` lines (atomic rewrite past the cap — a reader never
+    sees a torn file). Logging must never fail the caller: any I/O error
+    is swallowed."""
+    if max_entries is None:
+        max_entries = _PROBE_LOG_MAX
+    try:
+        with open(path, "a") as fp:
+            fp.write(json.dumps(obj) + "\n")
+        with open(path) as fp:
+            lines = fp.read().splitlines()
+        if len(lines) > max_entries:
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fp:
+                fp.write("\n".join(lines[-max_entries:]) + "\n")
+            os.replace(tmp, path)
+    except OSError:
+        pass

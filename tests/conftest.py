@@ -14,6 +14,10 @@ os.environ["ABPOA_TPU_SKIP_PROBE"] = "1"
 # wedge-simulation children would poison it for real runs on this host (and
 # a stale real verdict would defeat the simulation)
 os.environ["ABPOA_TPU_PROBE_CACHE_TTL"] = "0"
+# keep the suite's hundreds of CLI runs out of the user's cross-run report
+# archive (~/.cache/abpoa_tpu/reports); archive tests opt back in with an
+# explicit ABPOA_TPU_ARCHIVE_DIR + ABPOA_TPU_ARCHIVE=1 (tests/test_metrics.py)
+os.environ.setdefault("ABPOA_TPU_ARCHIVE", "0")
 # persistent compilation cache: the device-path tests are dominated by XLA
 # compile time (minutes per pallas-interpret variant); cache across runs and
 # across the subprocess-isolated children, which inherit this env

@@ -1,0 +1,439 @@
+"""Fleet-grade metrics subsystem tests (ISSUE 10 tentpole + satellites).
+
+- sketch honesty: p50/p95/p99 from the streaming log-bucket sketch agree
+  with exact nearest-rank percentiles within the declared tolerance on a
+  1M-record stream — 10x the old READS_CAP, where the capped-list path
+  used to lie
+- exporter golden: stable metric names/labels, exposition parses and
+  lints clean, atomic textfile writes, the stdlib HTTP endpoint serves
+  the same bytes
+- archive: one JSONL record per run, size-bounded rotation, windows span
+  the rotation boundary
+- slo: rc 0 on a healthy window, rc 1 once an injected violation spends
+  an objective's error budget, rc 2 with nothing to evaluate
+- `top --once` renders a frame from a live exporter file
+- `report --diff` compares two run reports field by field
+- probe-log bounding (utils/probe.py): the JSONL log keeps the newest N
+- overhead guard: metrics publication on vs off stays within noise of
+  the obs guard (host-side dict/array updates only, no device syncs)
+"""
+import io
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from conftest import DATA_DIR
+
+SIM2K = os.path.join(DATA_DIR, "sim2k.fa")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _native_or_skip():
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+
+
+# --------------------------------------------------------------------- #
+# sketch                                                                #
+# --------------------------------------------------------------------- #
+
+def test_sketch_percentiles_honest_at_10x_reads_cap():
+    """Acceptance: stream 1M synthetic per-read records (10x READS_CAP)
+    through record_read; sketch p50/p95/p99 match exact nearest-rank
+    percentiles within the declared relative error, while the raw-record
+    list stays capped."""
+    import importlib
+    R = importlib.import_module("abpoa_tpu.obs.report")
+    from abpoa_tpu.obs.metrics import LogSketch
+    rng = random.Random(7)
+    n = 10 * R.READS_CAP
+    vals = [rng.lognormvariate(-5.5, 1.3) for _ in range(n)]
+    rep = R.RunReport()
+    rec = rep.record_read
+    for v in vals:
+        rec(v, 100, 50, "native")
+    blk = rep._reads_block()
+    assert blk["count"] == n
+    assert blk["records_kept"] == R.READS_CAP
+    assert blk["dropped"] == n - R.READS_CAP
+    exact = sorted(vals)
+    tol = LogSketch.RELATIVE_ERROR
+    for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        ref = 1e3 * R.exact_percentile(exact, q)
+        est = blk["wall_ms"][key]
+        assert est == pytest.approx(ref, rel=tol), (key, est, ref)
+    # the capped-list path would have answered the percentile of the
+    # FIRST 100k records only; verify the sketch didn't
+    assert blk["wall_ms"]["max"] == pytest.approx(1e3 * exact[-1])
+
+
+def test_sketch_merge_and_bounds():
+    from abpoa_tpu.obs.metrics import LogSketch
+    a, b = LogSketch(), LogSketch()
+    rng = random.Random(3)
+    va = [rng.uniform(1e-4, 1e-1) for _ in range(5000)]
+    vb = [rng.uniform(1e-3, 1.0) for _ in range(5000)]
+    for v in va:
+        a.observe(v)
+    for v in vb:
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 10000
+    exact = sorted(va + vb)
+    import math
+    for q in (0.5, 0.95, 0.99):
+        ref = exact[max(0, math.ceil(q * len(exact)) - 1)]
+        assert a.quantile(q) == pytest.approx(ref, rel=a.RELATIVE_ERROR)
+    # memory bound: the bucket array never grows
+    assert len(a.counts) == LogSketch.N_BUCKETS
+    # out-of-range values clamp into the edge buckets (quantiles answer
+    # from there); exact min/max are preserved alongside
+    s = LogSketch()
+    s.observe(1e-9)
+    s.observe(1e6)
+    assert s.count == 2 and s.min == 1e-9 and s.max == 1e6
+    assert s.quantile(0.01) <= LogSketch.LO * LogSketch.GROWTH
+    assert s.quantile(1.0) >= LogSketch.HI / LogSketch.GROWTH
+
+
+# --------------------------------------------------------------------- #
+# exporter                                                              #
+# --------------------------------------------------------------------- #
+
+def test_exporter_golden_names_and_lint(tmp_path):
+    """The exposition of a real (numpy) run carries the stable family
+    names with their expected labels, parses, and lints clean."""
+    from abpoa_tpu import obs
+    from abpoa_tpu.obs import metrics as M
+    M.reset_registry()
+    from abpoa_tpu.pyapi import msa_aligner
+    a = msa_aligner(device="numpy")
+    a.msa(["ACGTACGTAA", "ACGTACGTA", "ACGTTCGTAA"], True, False)
+    path = str(tmp_path / "m.prom")
+    M.write_textfile(path)
+    with open(path) as fp:
+        text = fp.read()
+    assert M.lint_exposition(text) == []
+    samples, types = M.parse_exposition(text)
+    # goldened family names (renaming any of these is a breaking change
+    # for dashboards/alerts)
+    expected = {
+        "abpoa_runs_total": "counter",
+        "abpoa_reads_total": "counter",
+        "abpoa_read_wall_seconds": "histogram",
+        "abpoa_read_wall_seconds_quantile": "gauge",
+        "abpoa_phase_wall_seconds_total": "counter",
+        "abpoa_dispatches_total": "counter",
+        "abpoa_dp_cells_total": "counter",
+        "abpoa_dp_cell_ops_total": "counter",
+        "abpoa_dp_dispatches_total": "counter",
+        "abpoa_reads_per_second": "gauge",
+        "abpoa_cell_updates_per_second": "gauge",
+        "abpoa_trace_dropped_events": "gauge",
+    }
+    for fam, typ in expected.items():
+        assert types.get(fam) == typ, (fam, types.get(fam))
+    assert M.sample_value(samples, "abpoa_reads_total", backend="numpy") == 3
+    assert M.sample_value(samples, "abpoa_dispatches_total",
+                          backend="numpy") == 2
+    for q in ("0.5", "0.95", "0.99"):
+        assert M.sample_value(samples, "abpoa_read_wall_seconds_quantile",
+                              quantile=q) > 0
+    phases = {dict(lb).get("phase") for (n, lb) in samples
+              if n == "abpoa_phase_wall_seconds_total"}
+    assert {"align", "fusion", "consensus"} <= phases
+
+
+def test_textfile_exporter_flusher_and_http(tmp_path):
+    """start/stop of the periodic exporter (atomic writes) and the
+    stdlib HTTP endpoint serving the same exposition."""
+    from abpoa_tpu.obs import metrics as M
+    reg = M.reset_registry()
+    reg.counter("abpoa_runs_total", "Runs started").inc(1)
+    path = str(tmp_path / "live.prom")
+    M.start_textfile_exporter(path, interval_s=0.05)
+    try:
+        time.sleep(0.2)
+    finally:
+        M.stop_textfile_exporter()
+    with open(path) as fp:
+        text = fp.read()
+    assert M.lint_exposition(text) == []
+    assert "abpoa_runs_total 1" in text
+    # no torn-write droppings left behind
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+    srv = M.start_http_exporter(0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+        assert M.lint_exposition(body) == []
+        assert "abpoa_runs_total 1" in body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10) as resp:
+            pytest.fail("404 expected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_breaker_gauge_flips_and_resets():
+    """resilience publication: the breaker-state gauge reads 1 while a
+    backend is demoted and 0 again once the next run resets it."""
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.resilience.breaker import breaker
+    M.reset_registry()
+    br = breaker()
+    br.reset()
+    for _ in range(3):
+        br.record_failure("jax", "oom")
+    assert br.is_open("jax")
+    s, _ = M.parse_exposition(M.registry().render())
+    assert M.sample_value(s, "abpoa_breaker_open", backend="jax") == 1
+    br.reset()
+    s, _ = M.parse_exposition(M.registry().render())
+    assert M.sample_value(s, "abpoa_breaker_open", backend="jax") == 0
+
+
+def test_batch_progress_gauges():
+    """run_batch and msa_batch publish sets/done gauges, the live
+    progress `top` renders during a -l batch."""
+    import io
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import run_batch
+    M.reset_registry()
+    abpt = Params()
+    abpt.device = "numpy"
+    abpt.finalize()
+    out = io.StringIO()
+    stats = run_batch([os.path.join(DATA_DIR, "test.fa")] * 3, abpt, out)
+    assert stats["sets"] == 3
+    s, _ = M.parse_exposition(M.registry().render())
+    assert M.sample_value(s, "abpoa_batch_sets") == 3
+    assert M.sample_value(s, "abpoa_batch_sets_done") == 3
+    from abpoa_tpu.pyapi import msa_aligner
+    a = msa_aligner(device="numpy")
+    # one poisoned set (empty sequence): quarantined sets still count as
+    # done — the batch moved past them (same semantics as the -l runner)
+    res = a.msa_batch([["ACGTACGT", "ACGTACG"], [""],
+                       ["TTTTCCCC", "TTTTCCC"]], True, False)
+    assert res[1] is None and res[0] is not None and res[2] is not None
+    s, _ = M.parse_exposition(M.registry().render())
+    assert M.sample_value(s, "abpoa_batch_sets") == 3
+    assert M.sample_value(s, "abpoa_batch_sets_done") == 3
+    # a later non-batch run zeroes the run-scoped gauges instead of
+    # exporting stale progress
+    a.msa(["ACGTACGT", "ACGTACG"], True, False)
+    s, _ = M.parse_exposition(M.registry().render())
+    assert M.sample_value(s, "abpoa_batch_sets") == 0
+    assert M.sample_value(s, "abpoa_batch_sets_done") == 0
+
+
+# --------------------------------------------------------------------- #
+# archive + slo                                                         #
+# --------------------------------------------------------------------- #
+
+def _fake_report(p99_ms=5.0, reads=20, fallbacks=0, misses=0, faults=0):
+    rep = {"schema_version": 4, "created": "2026-08-04T00:00:00Z",
+           "total_wall_s": 1.0,
+           "counters": {"dp.cells": 1000},
+           "reads": {"count": reads,
+                     "fallbacks": {"x": fallbacks} if fallbacks else {},
+                     "wall_ms": {"p50": 1.0, "p95": 3.0, "p99": p99_ms,
+                                 "mean": 1.5, "max": p99_ms}},
+           "compiles": ({"hits": 4, "misses": misses} if misses else None),
+           "degraded": None, "mfu": None}
+    rep["faults"] = {"count": faults} if faults else None
+    return rep
+
+
+def test_archive_append_and_rotation(tmp_path, monkeypatch):
+    from abpoa_tpu.obs import archive
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path))
+    # tiny rotation bound (~10 records of ~330 B): 12 appends rotate once
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_MAX_MB", "0.003")  # 3000 bytes
+    for i in range(12):
+        p = archive.append_report(_fake_report(p99_ms=float(i)),
+                                  label=f"run{i}", device="numpy")
+        assert p is not None
+    live = archive.archive_path()
+    assert os.path.exists(live + ".1"), "rotation never happened"
+    # bounded: live + one rotated generation, never unbounded growth
+    live_size = os.path.getsize(live) if os.path.exists(live) else 0
+    assert live_size <= 2 * 3000
+    assert os.path.getsize(live + ".1") <= 2 * 3000
+    # windows span the rotation boundary, oldest-first, newest retained
+    win = archive.read_window(6)
+    assert [r["label"] for r in win] == [f"run{i}" for i in range(6, 12)]
+    assert win[-1]["read_wall_ms"]["p99"] == 11.0
+    # disabled archiving writes nothing
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "0")
+    assert archive.append_report(_fake_report()) is None
+
+
+def test_slo_rc_flips_on_injected_violation(tmp_path, monkeypatch):
+    """Acceptance: `abpoa-tpu slo` exits 0 on a healthy window and
+    nonzero once injected p99 violations exhaust the error budget."""
+    from abpoa_tpu.obs import archive
+    from abpoa_tpu.obs.slo import slo_main
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "arch"))
+    objectives = {
+        "window_runs": 50,
+        "objectives": [
+            {"name": "read-p99-wall", "metric": "read_p99_ms",
+             "max": 100.0, "error_budget": 0.10},
+            {"name": "fault-rate", "metric": "fault_rate",
+             "max": 0.0, "error_budget": 0.10},
+        ]}
+    obj = str(tmp_path / "obj.json")
+    with open(obj, "w") as fp:
+        json.dump(objectives, fp)
+    # empty archive: nothing to evaluate -> rc 2
+    assert slo_main(["--objectives", obj, "-q"]) == 2
+    for _ in range(20):
+        archive.append_report(_fake_report(p99_ms=5.0))
+    assert slo_main(["--objectives", obj, "-q"]) == 0
+    # one bad run out of 21 (~4.8%) stays inside the 10% budget
+    archive.append_report(_fake_report(p99_ms=5000.0))
+    assert slo_main(["--objectives", obj, "-q"]) == 0
+    # two more bad runs (3/23 = 13%) spend the budget -> rc 1
+    archive.append_report(_fake_report(p99_ms=5000.0))
+    archive.append_report(_fake_report(p99_ms=5000.0))
+    out = str(tmp_path / "slo.json")
+    assert slo_main(["--objectives", obj, "--json", out, "-q"]) == 1
+    with open(out) as fp:
+        res = json.load(fp)
+    byname = {o["name"]: o for o in res["objectives"]}
+    assert byname["read-p99-wall"]["violated"] is True
+    assert byname["read-p99-wall"]["bad"] == 3
+    assert byname["read-p99-wall"]["burn_rate"] > 1.0
+    assert byname["fault-rate"]["violated"] is False
+    assert res["violated"] is True
+
+
+def test_cli_run_archives_and_slo_end_to_end(tmp_path, monkeypatch):
+    """A real CLI run (numpy) archives its report; `abpoa-tpu slo`
+    evaluates the shipped tools/slo_objectives.json against it."""
+    from abpoa_tpu.cli import main
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "arch"))
+    rc = main([os.path.join(DATA_DIR, "test.fa"), "--device", "numpy",
+               "-o", str(tmp_path / "c.fa")])
+    assert rc == 0
+    from abpoa_tpu.obs import archive
+    win = archive.read_window(10)
+    assert len(win) == 1 and win[0]["reads"] == 4
+    assert main(["slo", "-q"]) == 0
+
+
+# --------------------------------------------------------------------- #
+# top + diff                                                            #
+# --------------------------------------------------------------------- #
+
+def test_top_once_renders_frame(tmp_path, capsys):
+    from abpoa_tpu.cli import main
+    from abpoa_tpu.obs import metrics as M
+    reg = M.reset_registry()
+    reg.counter("abpoa_runs_total", "Runs started").inc(2)
+    reg.counter("abpoa_reads_total", "reads").inc(40, backend="jax")
+    reg.counter("abpoa_phase_wall_seconds_total",
+                "phase walls").inc(3.0, phase="align_fused")
+    reg.counter("abpoa_phase_wall_seconds_total",
+                "phase walls").inc(1.0, phase="consensus")
+    reg.counter("abpoa_compile_misses_total", "misses").inc(1)
+    reg.gauge("abpoa_breaker_open", "breaker").set(1, backend="pallas")
+    path = str(tmp_path / "m.prom")
+    M.write_textfile(path)
+    assert main(["top", path, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "runs 2" in out
+    assert "align_fused" in out and "75.0%" in out
+    assert "pallas=OPEN" in out
+    assert "compiles 1 compiled" in out
+    # missing file: a waiting frame, not a crash
+    assert main(["top", str(tmp_path / "absent.prom"), "--once"]) == 0
+    assert "waiting for" in capsys.readouterr().out
+
+
+def test_report_diff(tmp_path, capsys):
+    """`abpoa-tpu report --diff A B` renders per-field delta + percent
+    change for two real run reports."""
+    from abpoa_tpu.cli import main
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    for path in (a, b):
+        rc = main([os.path.join(DATA_DIR, "test.fa"), "--device", "numpy",
+                   "-o", str(tmp_path / "c.fa"), "--report", path])
+        assert rc == 0
+    assert main(["report", "--diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "report diff:" in out
+    for field in ("total_wall_s", "reads_per_sec", "read_p99_ms",
+                  "phase.align_s", "dp_cells"):
+        assert field in out
+    assert main(["report", "--diff", a]) == 2
+
+
+# --------------------------------------------------------------------- #
+# probe-log bounding                                                    #
+# --------------------------------------------------------------------- #
+
+def test_probe_log_bounded(tmp_path):
+    from abpoa_tpu.utils.probe import append_jsonl_bounded
+    path = str(tmp_path / "probe.jsonl")
+    for i in range(230):
+        append_jsonl_bounded(path, {"i": i}, max_entries=100)
+    with open(path) as fp:
+        lines = fp.read().splitlines()
+    assert len(lines) == 100
+    assert json.loads(lines[0]) == {"i": 130}   # newest kept, oldest gone
+    assert json.loads(lines[-1]) == {"i": 229}
+    # unwritable path: swallowed, never raises
+    append_jsonl_bounded(os.path.join(str(tmp_path), "no", "dir.jsonl"),
+                         {"x": 1})
+
+
+# --------------------------------------------------------------------- #
+# overhead guard                                                        #
+# --------------------------------------------------------------------- #
+
+def test_metrics_overhead_guard_sim2k():
+    """Metric publication must be free (same contract as the PR 6 obs
+    guard): warm sim2k wall with the registry mirror enabled stays within
+    noise of disabled — every publication is a host-side dict/array
+    update, never a device sync."""
+    _native_or_skip()
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    def run_once():
+        abpt = Params()
+        abpt.device = "native"
+        abpt.finalize()
+        t0 = time.perf_counter()
+        msa_from_file(Abpoa(), abpt, SIM2K, io.StringIO())
+        return time.perf_counter() - t0
+
+    run_once()  # warm
+    try:
+        M.set_enabled(True)
+        on = min(run_once() for _ in range(2))
+        M.set_enabled(False)
+        off = min(run_once() for _ in range(2))
+    finally:
+        M.set_enabled(True)
+    assert on <= off * 1.25 + 0.05, (on, off)

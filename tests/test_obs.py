@@ -47,7 +47,7 @@ def test_report_schema_golden():
     rep = a.last_report
     assert tuple(rep.keys()) == obs.SCHEMA_KEYS
     assert rep["schema"] == obs.SCHEMA
-    assert rep["schema_version"] == obs.SCHEMA_VERSION == 3
+    assert rep["schema_version"] == obs.SCHEMA_VERSION == 4
     # v3: a clean run carries no fault history and no demotions
     assert rep["faults"] is None and rep["degraded"] is None
     assert rep["counters"]["dispatch.numpy"] == 2
@@ -85,7 +85,7 @@ def test_cli_report_sim2k(tmp_path):
     assert rc == 0
     with open(rpt) as fp:
         rep = json.load(fp)
-    assert rep["schema_version"] == 3
+    assert rep["schema_version"] == 4
     assert rep["counters"]["dispatch.native"] > 0
     assert rep["counters"]["dp.cells"] > 0
     assert rep["values"]["dp.band_width"]["max"] > 0
@@ -318,25 +318,28 @@ def test_compile_log_second_dispatch_is_cache_hit():
 
 
 def test_record_read_percentiles_and_cap():
-    """Nearest-rank percentiles over the per-read stream; past READS_CAP
-    records are dropped and counted, never silently truncated."""
+    """Sketch-based percentiles over the per-read stream (schema v4):
+    estimates stay within the declared relative error, and past READS_CAP
+    only the qlen/band attribution records are dropped (and counted) —
+    the percentile path keeps seeing every read."""
     # obs.report the *attribute* is a function; get the module itself
     import importlib
     R = importlib.import_module("abpoa_tpu.obs.report")
+    tol = R._metrics.LogSketch.RELATIVE_ERROR
     rep = R.RunReport()
     for i in range(100):
         rep.record_read((i + 1) / 1000.0, qlen=100 + i, band_cols=50,
                         backend="native")
     blk = rep._reads_block()
     assert blk["count"] == 100 and blk["dropped"] == 0
-    # nearest-rank: p50 = 50th of 100 = 0.050 s, p99 = 99th = 0.099 s
-    assert blk["wall_ms"]["p50"] == pytest.approx(50.0)
-    assert blk["wall_ms"]["p95"] == pytest.approx(95.0)
-    assert blk["wall_ms"]["p99"] == pytest.approx(99.0)
-    assert blk["wall_ms"]["max"] == pytest.approx(100.0)
+    # nearest-rank references: p50 = 50th of 100 = 0.050 s, p99 = 0.099 s
+    assert blk["wall_ms"]["p50"] == pytest.approx(50.0, rel=tol)
+    assert blk["wall_ms"]["p95"] == pytest.approx(95.0, rel=tol)
+    assert blk["wall_ms"]["p99"] == pytest.approx(99.0, rel=tol)
+    assert blk["wall_ms"]["max"] == pytest.approx(100.0)  # min/max exact
+    assert blk["sketch"]["relative_error"] == tol
     assert blk["qlen"] == {"min": 100, "max": 199, "mean": 149.5}
-    rep.reads = rep.reads[:0]
-    rep.reads_dropped = 0
+    rep = R.RunReport()
     old_cap = R.READS_CAP
     try:
         R.READS_CAP = 10
@@ -345,8 +348,12 @@ def test_record_read_percentiles_and_cap():
     finally:
         R.READS_CAP = old_cap
     blk = rep._reads_block()
-    assert blk["count"] == 10 and blk["dropped"] == 5
-    assert blk["fallbacks"] == {"fused_bypass": 10}
+    # count covers ALL reads (the sketch's honesty past the cap); the
+    # raw-record drop is still visible and counted
+    assert blk["count"] == 15
+    assert blk["records_kept"] == 10 and blk["dropped"] == 5
+    assert blk["fallbacks"] == {"fused_bypass": 15}
+    assert blk["backends"] == {"numpy": 15}
 
 
 def test_report_viewer(tmp_path):
@@ -362,7 +369,7 @@ def test_report_viewer(tmp_path):
     with open(rpt) as fp:
         rep = json.load(fp)
     text = render_report(rep)
-    assert "run report (schema v3)" in text
+    assert "run report (schema v4)" in text
     for name in rep["phases"]:
         assert name in text
     assert "p50" in text and "dispatch.native" in text

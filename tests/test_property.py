@@ -1,11 +1,17 @@
 """Property tests: the JAX kernel must agree with the NumPy oracle on random
-progressive-POA runs across {align mode} x {gap regime} x {banding}.
+progressive-POA runs across {align mode} x {gap regime} x {banding}, and the
+host engines must agree with each other up to the documented penalty bounds.
 
 This is the moral equivalent of the reference's __SIMD_DEBUG__ scalar kernel
 used as an oracle for the vector kernel (SURVEY.md §4).
 """
+import io
+import os
+
 import numpy as np
 import pytest
+
+from conftest import DATA_DIR
 
 from abpoa_tpu import constants as C
 from abpoa_tpu.graph import POAGraph
@@ -128,3 +134,68 @@ def test_jax_matches_oracle_zdrop_pathscore(mode, gap, wb, extra):
         oracle_mod.align_sequence_to_subgraph_numpy = orig
     assert cons_np == cons_jx
     assert calls["n"] == 0, "jax path silently fell back to the oracle"
+
+
+# --------------------------------------------------------------------- #
+# the -E gap-extension contract (ROADMAP item 5, PERF.md round 10):     #
+# parity through 63, explicit rejection from 64 — the regime where the  #
+# reference binary crashes ("Error in lg_backtrack") and the in-tree    #
+# engines were measured to diverge                                      #
+# --------------------------------------------------------------------- #
+
+def _msa_output(device: str, ext: int, records) -> str:
+    from abpoa_tpu.pipeline import msa
+    abpt = Params()
+    abpt.gap_open1 = abpt.gap_open2 = 0   # linear gaps: -O 0 -E ext
+    abpt.gap_ext1, abpt.gap_ext2 = ext, 0
+    abpt.device = device
+    abpt.finalize()
+    buf = io.StringIO()
+    msa(Abpoa(), abpt, records, buf)
+    return buf.getvalue()
+
+
+@pytest.mark.parametrize("ext", [40, 56, 63])
+def test_native_oracle_parity_up_to_gap_ext_bound(ext):
+    """The historical round-5 divergence witness (seq.fa, -O 0): native
+    and the numpy oracle must agree byte-for-byte right up to the
+    documented bound (the measured boundary is exactly 64)."""
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+    from abpoa_tpu.io.fastx import read_fastx
+    records = read_fastx(os.path.join(DATA_DIR, "seq.fa"))
+    assert _msa_output("numpy", ext, records) == \
+        _msa_output("native", ext, records)
+
+
+def test_gap_ext_at_bound_rejected():
+    """-E>=64 is a validation error (clamp-or-error decision: ERROR —
+    clamping would silently change scoring semantics), for either
+    extension and from the CLI as a structured one-liner, never a
+    traceback."""
+    abpt = Params()
+    abpt.gap_open1 = abpt.gap_open2 = 0
+    abpt.gap_ext1 = C.MAX_GAP_EXT
+    abpt.gap_ext2 = 0
+    with pytest.raises(ValueError, match="supported range"):
+        abpt.finalize()
+    # the convex second-level extension is bounded identically
+    abpt2 = Params()
+    abpt2.gap_ext2 = C.MAX_GAP_EXT + 8
+    with pytest.raises(ValueError, match="supported range"):
+        abpt2.finalize()
+    # one below the bound finalizes fine
+    abpt3 = Params()
+    abpt3.gap_open1 = abpt3.gap_open2 = 0
+    abpt3.gap_ext1, abpt3.gap_ext2 = C.MAX_GAP_EXT - 1, 0
+    abpt3.finalize()
+
+
+def test_gap_ext_bound_cli_structured_error(capsys):
+    from abpoa_tpu.cli import main
+    rc = main([os.path.join(DATA_DIR, "seq.fa"), "-O", "0", "-E", "64"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert err.startswith("Error:") and "supported range" in err
+    assert "Traceback" not in err

@@ -10,7 +10,10 @@ injected (ABPOA_TPU_INJECT=..., abpoa_tpu/resilience/inject.py), a multi-set
 - exit rc=0 (healthy sets complete; the run degrades, never dies),
 - emit a consensus for every healthy set,
 - carry the corresponding `faults` records — plus the circuit-breaker
-  `degraded` block or quarantine counters — in the --report JSON.
+  `degraded` block or quarantine counters — in the --report JSON,
+- leave a lint-clean Prometheus exposition (--metrics) whose
+  `abpoa_breaker_open{backend="jax"}` gauge reads 1 for the scenarios
+  that tripped the breaker and whose fault counters match the injector.
 
 Each injector runs in a fresh subprocess (injection spec and breaker state
 are process-global). The device backend is `jax` pinned to CPU, so this
@@ -31,6 +34,7 @@ import tempfile
 TOOLS = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(TOOLS)
 DATA = os.path.join(REPO, "tests", "data")
+sys.path.insert(0, REPO)
 
 # injector -> (expected fault kind, expect breaker-degraded block)
 SCENARIOS = {
@@ -51,12 +55,14 @@ def run_one(spec: str, tmp: str, verbose: bool) -> list:
             fp.write(os.path.join(DATA, "test.fa") + "\n")
     out = os.path.join(tmp, f"out_{name}.fa")
     rpt = os.path.join(tmp, f"report_{name}.json")
+    mtx = os.path.join(tmp, f"metrics_{name}.prom")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         ABPOA_TPU_SKIP_PROBE="1",
         ABPOA_TPU_INJECT=spec,
         ABPOA_TPU_BREAKER_THRESHOLD="2",
+        ABPOA_TPU_ARCHIVE_DIR=os.path.join(tmp, "reports"),
     )
     if name == "hang":
         # short injected hang + tight deadline — ONLY for the hang
@@ -67,7 +73,7 @@ def run_one(spec: str, tmp: str, verbose: bool) -> list:
         env["ABPOA_TPU_WATCHDOG_S"] = "0.5"
     proc = subprocess.run(
         [sys.executable, "-m", "abpoa_tpu.cli", "-l", lst, "--device", "jax",
-         "-o", out, "--report", rpt],
+         "-o", out, "--report", rpt, "--metrics", mtx],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     failures = []
     expected_kind, expect_degraded = SCENARIOS[spec]
@@ -93,6 +99,25 @@ def run_one(spec: str, tmp: str, verbose: bool) -> list:
                         "missing)")
     if name == "poison_set" and not rep["counters"].get("quarantine.sets"):
         failures.append(f"{name}: quarantine counter missing")
+    # the fleet registry's view of the same run (ISSUE 10): the exposition
+    # must lint clean, carry the injector's fault counter, and — for the
+    # breaker scenarios — show the breaker-state gauge flipped to open
+    from abpoa_tpu.obs import metrics as M
+    with open(mtx) as fp:
+        text = fp.read()
+    lint = M.lint_exposition(text)
+    if lint:
+        failures.append(f"{name}: exposition lint: {lint[:3]}")
+    samples, _types = M.parse_exposition(text)
+    if not M.sample_value(samples, "abpoa_faults_total", kind=expected_kind):
+        failures.append(f"{name}: abpoa_faults_total"
+                        f'{{kind="{expected_kind}"}} missing from metrics')
+    if expect_degraded:
+        gauge = M.sample_value(samples, "abpoa_breaker_open", backend="jax")
+        if gauge != 1:
+            failures.append(f"{name}: abpoa_breaker_open{{backend=\"jax\"}} "
+                            f"= {gauge}, expected 1 after the breaker "
+                            "tripped")
     if verbose:
         print(f"[chaos-smoke] {name}: rc=0, {n_cons} consensus, "
               f"faults={kinds}, degraded={sorted(rep.get('degraded') or {})}")

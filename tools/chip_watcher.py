@@ -47,9 +47,20 @@ def now():
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+
 def log_probe(status, **kw):
-    with open(PROBE_LOG, "a") as fp:
-        fp.write(json.dumps({"ts": now(), "tpu": status, **kw}) + "\n")
+    # bounded append (utils/probe.py): the probe log keeps only the newest
+    # ABPOA_TPU_PROBE_LOG_MAX entries instead of growing forever on a
+    # long-lived host
+    try:
+        from abpoa_tpu.utils.probe import append_jsonl_bounded
+        append_jsonl_bounded(PROBE_LOG, {"ts": now(), "tpu": status, **kw})
+    except ImportError:
+        with open(PROBE_LOG, "a") as fp:
+            fp.write(json.dumps({"ts": now(), "tpu": status, **kw}) + "\n")
 
 
 def probe():

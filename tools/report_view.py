@@ -1,10 +1,14 @@
 #!/usr/bin/env python
 """Render `--report` JSON run reports as one-screen tables.
 
-Thin checkout-local wrapper over `abpoa-tpu report FILE` (cli.report_main)
+Thin checkout-local wrapper over `abpoa-tpu report` (cli.report_main)
 for environments without the console script installed:
 
     python tools/report_view.py run_report.json
+    python tools/report_view.py --diff before.json after.json
+
+`--diff` compares two reports field by field (phase walls, reads/s,
+CUPS, compiles, faults) with per-field delta and percent change.
 """
 from __future__ import annotations
 
