@@ -103,8 +103,10 @@ def _resolve(abpt: Params) -> Callable:
     from ..resilience.breaker import breaker
     name = "jax" if abpt.device == "tpu" else abpt.device
     reason = None
-    # the circuit breaker demotes a failing backend for the rest of the
-    # run (resilience/breaker.py warns + reports the open, once)
+    # the circuit breaker demotes a failing backend until its half-open
+    # cooldown elapses (resilience/breaker.py warns + reports the open,
+    # once); effective() names the original backend again once a probe
+    # is allowed, so guarded_device_call can claim the permit from here
     eff = breaker().effective(name)
     if eff != name:
         count(f"breaker.demoted.{name}")

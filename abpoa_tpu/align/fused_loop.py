@@ -68,7 +68,8 @@ from ..compile import registry
 from ..compile.buckets import bucket as _bucket
 from ..compile.buckets import bucket_pow2 as _bucket_pow2
 from ..compile.buckets import grow_node_cap
-from ..compile.ladder import k_rung, qp_rung, reads_rung
+from ..compile.ladder import (chunk_node_cap, k_rung, plan_chunk_buckets,
+                              qp_rung, reads_rung)
 from ..params import Params
 from .device_graph import DeviceGraph, fuse_alignment, init_device_graph, topo_sort
 # re-exported for device-path callers; defined in a jax-free module so
@@ -1503,16 +1504,9 @@ _RECOVERABLE_ERRS = (ERR_PROMOTE, ERR_NODE_CAP, ERR_OPS_CAP, ERR_BAND_CAP,
 
 def _plan_buckets(abpt: Params, qmax: int) -> Tuple[int, int, bool]:
     """(Qp, W, local_mode) for a workload whose longest read is qmax.
-    All rungs come from the declared ladder (compile/ladder.py)."""
-    Qp = qp_rung(qmax)
-    local_m = abpt.align_mode == C.LOCAL_MODE
-    if local_m:
-        # local disables banding: every row spans the full query
-        W = max(128, _bucket_pow2(qmax + 2))
-    else:
-        w_full = abpt.wb + int(abpt.wf * qmax)
-        W = max(128, _bucket_pow2(2 * w_full + 4))
-    return Qp, W, local_m
+    Delegates to the shared definition site (compile/ladder.py) that
+    serve admission pricing also reads."""
+    return plan_chunk_buckets(abpt, qmax)
 
 
 def partition_by_length_bucket(entries):
@@ -1539,7 +1533,7 @@ def plan_dispatch_footprint(abpt: Params, seq_sets) -> dict:
     R = reads_rung(max((len(ss) for ss in seq_sets), default=1))
     K = len(seq_sets)
     Kb = k_rung(K) if K > 1 else 1
-    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    N = chunk_node_cap(qmax)
     plane16 = max_score_bound(abpt, qmax, 2) <= int16_score_limit(abpt)
     return dict(N=N, E=8, A=8, W=W, Qp=Qp, reads=R, K=Kb,
                 plane16=plane16, gap_mode=abpt.gap_mode, m=abpt.m)
@@ -1882,7 +1876,7 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
     Qp, W, local_m = _plan_buckets(abpt, qmax)
     E = 8
     A = 8
-    N = _bucket(2 * (qmax + 2) + 64, 1024)
+    N = chunk_node_cap(qmax)
     if _initial_caps is not None:
         N, E, A, W = _initial_caps
 

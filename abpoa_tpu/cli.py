@@ -90,6 +90,38 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def apply_gap_args(abpt: Params, gap_open, gap_ext) -> None:
+    """Parse the -O/-E "o1[,o2]"/"e1[,e2]" forms (shared with `serve`)."""
+    if gap_open is not None:
+        parts = gap_open.split(",")
+        abpt.gap_open1 = int(parts[0])
+        abpt.gap_open2 = int(parts[1]) if len(parts) > 1 else 0
+    if gap_ext is not None:
+        parts = gap_ext.split(",")
+        abpt.gap_ext1 = int(parts[0])
+        abpt.gap_ext2 = int(parts[1]) if len(parts) > 1 else 0
+
+
+def apply_result_mode(abpt: Params, r: int) -> bool:
+    """Decode the -r output mode onto `abpt` (shared with `serve`);
+    returns False for an unknown mode."""
+    if r == C.OUT_CONS:
+        abpt.out_cons, abpt.out_msa = True, False
+    elif r == C.OUT_MSA:
+        abpt.out_cons, abpt.out_msa = False, True
+    elif r == C.OUT_CONS_MSA:
+        abpt.out_cons = abpt.out_msa = True
+    elif r == C.OUT_GFA:
+        abpt.out_cons, abpt.out_gfa = False, True
+    elif r == C.OUT_CONS_GFA:
+        abpt.out_cons = abpt.out_gfa = True
+    elif r == C.OUT_CONS_FQ:
+        abpt.out_cons = abpt.out_fq = True
+    else:
+        return False
+    return True
+
+
 def args_to_params(args: argparse.Namespace) -> Params:
     abpt = Params()
     abpt.align_mode = args.aln_mode
@@ -98,14 +130,7 @@ def args_to_params(args: argparse.Namespace) -> Params:
     if args.matrix:
         abpt.use_score_matrix = True
         abpt.mat_fn = args.matrix
-    if args.gap_open is not None:
-        parts = args.gap_open.split(",")
-        abpt.gap_open1 = int(parts[0])
-        abpt.gap_open2 = int(parts[1]) if len(parts) > 1 else 0
-    if args.gap_ext is not None:
-        parts = args.gap_ext.split(",")
-        abpt.gap_ext1 = int(parts[0])
-        abpt.gap_ext2 = int(parts[1]) if len(parts) > 1 else 0
+    apply_gap_args(abpt, args.gap_open, args.gap_ext)
     abpt.wb = args.extra_b
     abpt.wf = args.extra_f
     abpt.zdrop = args.zdrop
@@ -124,21 +149,9 @@ def args_to_params(args: argparse.Namespace) -> Params:
         abpt.m = 27
     abpt.incr_fn = args.increment
     abpt.amb_strand = args.amb_strand
-    r = args.result
-    if r == C.OUT_CONS:
-        abpt.out_cons, abpt.out_msa = True, False
-    elif r == C.OUT_MSA:
-        abpt.out_cons, abpt.out_msa = False, True
-    elif r == C.OUT_CONS_MSA:
-        abpt.out_cons = abpt.out_msa = True
-    elif r == C.OUT_GFA:
-        abpt.out_cons, abpt.out_gfa = False, True
-    elif r == C.OUT_CONS_GFA:
-        abpt.out_cons = abpt.out_gfa = True
-    elif r == C.OUT_CONS_FQ:
-        abpt.out_cons = abpt.out_fq = True
-    else:
-        print(f"Error: unknown output result mode: {r}.", file=sys.stderr)
+    if not apply_result_mode(abpt, args.result):
+        print(f"Error: unknown output result mode: {args.result}.",
+              file=sys.stderr)
     abpt.out_pog = args.out_pog
     abpt.cons_algrm = args.cons_algrm
     if not 1 <= args.maxnum_cons <= 10:
@@ -251,6 +264,9 @@ def main(argv=None) -> int:
         return report_main(raw[1:])
     if raw[:1] == ["warm"]:
         return warm_main(raw[1:])
+    if raw[:1] == ["serve"]:
+        from .serve import serve_main
+        return serve_main(raw[1:])
     if raw[:1] == ["slo"]:
         from .obs.slo import slo_main
         return slo_main(raw[1:])

@@ -94,6 +94,30 @@ def k_rung(k: int, mesh_size: int = 1) -> int:
     return r
 
 
+def plan_chunk_buckets(abpt, qmax: int):
+    """(Qp, W, local_mode) for a fused-chunk workload whose longest read
+    is qmax — THE definition site shared by the fused planner
+    (fused_loop._plan_buckets) and serve admission pricing
+    (serve/admission.request_caps), so the byte gate can never drift
+    from the shapes the dispatch actually allocates. jax-free on
+    purpose: admission prices before/without a jax import."""
+    from .. import constants as C
+    Qp = qp_rung(qmax)
+    local_m = abpt.align_mode == C.LOCAL_MODE
+    if local_m:
+        # local disables banding: every row spans the full query
+        W = max(128, bucket_pow2(qmax + 2))
+    else:
+        w_full = abpt.wb + int(abpt.wf * qmax)
+        W = max(128, bucket_pow2(2 * w_full + 4))
+    return Qp, W, local_m
+
+
+def chunk_node_cap(qmax: int) -> int:
+    """Start node capacity of a fused chunk (shared with admission)."""
+    return bucket(2 * (qmax + 2) + 64, 1024)
+
+
 # ---- warm tiers ---------------------------------------------------------- #
 
 class WarmAnchor(NamedTuple):

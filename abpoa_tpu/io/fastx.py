@@ -27,46 +27,59 @@ def _open(path: str):
     return open(path, "rt")
 
 
-def iter_fastx(path: str) -> Iterator[SeqRecord]:
-    """Hardened against the malformed inputs the quarantine fuzz grid
+def iter_fastx_handle(fp) -> Iterator[SeqRecord]:
+    """Parse FASTA/FASTQ records from an open text handle (a file, or a
+    StringIO over an `abpoa-tpu serve` request body).
+
+    Hardened against the malformed inputs the quarantine fuzz grid
     feeds it (tests/test_resilience.py): CRLF line endings are stripped
     everywhere (a '\\r' left in a sequence would silently encode as an
     ambiguous base), and a FASTQ record truncated at EOF yields its
     partial fields as-is — `resilience.validate_records` then rejects the
     set with a structured per-set error instead of a wrong consensus."""
+    name = comment = None
+    seq_parts: List[str] = []
+    in_qual = False
+    for line in fp:
+        line = line.rstrip("\r\n")
+        if not line and not in_qual:
+            continue
+        if line.startswith(">") or (line.startswith("@") and not in_qual and name is None):
+            if name is not None:
+                yield SeqRecord(name, comment or "", "".join(seq_parts), None)
+            head = line[1:].split(None, 1)
+            name = head[0] if head else ""
+            comment = head[1] if len(head) > 1 else ""
+            seq_parts, in_qual = [], False
+            is_fq = line.startswith("@")
+            if is_fq:
+                # FASTQ: strict 4-line records (readline() returns ""
+                # past EOF, so a truncated record yields short fields
+                # for validation to reject — never an exception here)
+                seq = fp.readline().rstrip("\r\n")
+                fp.readline()  # '+'
+                qual = fp.readline().rstrip("\r\n")
+                yield SeqRecord(name, comment or "", seq, qual)
+                name = None
+        else:
+            seq_parts.append(line)
+    if name is not None:
+        yield SeqRecord(name, comment or "", "".join(seq_parts), None)
+
+
+def iter_fastx(path: str) -> Iterator[SeqRecord]:
     with _open(path) as fp:
-        name = comment = None
-        seq_parts: List[str] = []
-        qual_parts: List[str] = []
-        in_qual = False
-        for line in fp:
-            line = line.rstrip("\r\n")
-            if not line and not in_qual:
-                continue
-            if line.startswith(">") or (line.startswith("@") and not in_qual and name is None):
-                if name is not None:
-                    yield SeqRecord(name, comment or "", "".join(seq_parts), None)
-                head = line[1:].split(None, 1)
-                name = head[0] if head else ""
-                comment = head[1] if len(head) > 1 else ""
-                seq_parts, qual_parts, in_qual = [], [], False
-                is_fq = line.startswith("@")
-                if is_fq:
-                    # FASTQ: strict 4-line records (readline() returns ""
-                    # past EOF, so a truncated record yields short fields
-                    # for validation to reject — never an exception here)
-                    seq = fp.readline().rstrip("\r\n")
-                    fp.readline()  # '+'
-                    qual = fp.readline().rstrip("\r\n")
-                    yield SeqRecord(name, comment or "", seq, qual)
-                    name = None
-            else:
-                seq_parts.append(line)
-        if name is not None:
-            yield SeqRecord(name, comment or "", "".join(seq_parts), None)
+        yield from iter_fastx_handle(fp)
 
 
 def read_fastx(path: str) -> List[SeqRecord]:
     from ..obs import phase
     with phase("fastx_parse"):
         return list(iter_fastx(path))
+
+
+def read_fastx_text(text: str) -> List[SeqRecord]:
+    """Records from in-memory FASTA/FASTQ text (the serve request-body
+    path) — same parser, same hardening, no filesystem."""
+    import io
+    return list(iter_fastx_handle(io.StringIO(text)))

@@ -2,11 +2,20 @@
 
 Each CLI run appends one compact JSONL record (the SLO-relevant slice of
 its RunReport — wall, read percentiles, fallback/recompile/fault counts)
-to ``~/.cache/abpoa_tpu/reports/reports.jsonl``. The archive is what
-turns per-run telemetry into fleet questions: "what was our fallback
-rate across the last 500 runs", "has warm p99 drifted this week" —
-the sustained-workload reporting SeGraM / AnySeq-style evaluations use
-instead of single cold runs.
+to ``~/.cache/abpoa_tpu/reports/reports.jsonl``; `abpoa-tpu serve`
+appends one record per REQUEST through the same `append_record`, so the
+archive is what turns per-run telemetry into fleet questions: "what was
+our fallback rate across the last 500 runs", "has warm p99 drifted this
+week" — the sustained-workload reporting SeGraM / AnySeq-style
+evaluations use instead of single cold runs.
+
+Writers are concurrent: server worker threads append per-request records
+while the flusher and CLI runs append theirs. Every record is therefore
+written as ONE ``os.write`` on an ``O_APPEND`` descriptor — the kernel
+serializes same-host appends, so lines can never interleave — and
+rotation runs under a process lock (cross-thread) with a re-stat inside
+it (cheap cross-process defense: at worst two processes rotate back to
+back, which drops one generation early, never a torn line).
 
 Growth is bounded: past ``ABPOA_TPU_ARCHIVE_MAX_MB`` (default 8 MB,
 ~20k records) the live file rotates to ``reports.jsonl.1`` (one rotated
@@ -18,10 +27,15 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import List, Optional
 
 ARCHIVE_FILE = "reports.jsonl"
+
+# serializes rotation against in-process writers; the append itself needs
+# no lock (single O_APPEND write)
+_ROTATE_LOCK = threading.Lock()
 
 
 def archive_enabled() -> bool:
@@ -78,31 +92,48 @@ def summarize_report(rep: dict, label: str = "",
     }
 
 
-def append_report(rep: dict, label: str = "", device: str = "") -> Optional[str]:
-    """Archive one finalized run report; returns the record path (None
-    when archiving is disabled or the directory is unwritable — archive
-    failure must never fail the run that produced the report)."""
+def append_record(rec: dict) -> Optional[str]:
+    """Append one archive record (any dict with the summarize_report /
+    serve-request field shapes). Thread- and process-safe: the line lands
+    as a single O_APPEND write, so concurrent appenders can never
+    interleave bytes mid-record. Returns the archive path (None when
+    archiving is disabled or the directory is unwritable — archive
+    failure must never fail the work that produced the record)."""
     if not archive_enabled():
         return None
-    rec = summarize_report(rep, label=label, device=device)
     path = archive_path()
+    data = (json.dumps(rec) + "\n").encode()
     try:
         os.makedirs(archive_dir(), exist_ok=True)
-        with open(path, "a") as fp:
-            fp.write(json.dumps(rec) + "\n")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
         _rotate_if_needed(path)
     except OSError:
         return None
     return path
 
 
+def append_report(rep: dict, label: str = "", device: str = "") -> Optional[str]:
+    """Archive one finalized run report (the CLI's per-run record)."""
+    if not archive_enabled():
+        return None
+    return append_record(summarize_report(rep, label=label, device=device))
+
+
 def _rotate_if_needed(path: str) -> None:
-    try:
-        if os.path.getsize(path) <= max_bytes():
-            return
-        os.replace(path, path + ".1")  # drops any previous .1
-    except OSError:
-        pass
+    # the lock serializes in-process rotations (server threads); the
+    # re-stat inside it means only the first thread past the limit
+    # rotates — late arrivals see the fresh small file and return
+    with _ROTATE_LOCK:
+        try:
+            if os.path.getsize(path) <= max_bytes():
+                return
+            os.replace(path, path + ".1")  # drops any previous .1
+        except OSError:
+            pass
 
 
 def read_window(n: int, path: Optional[str] = None) -> List[dict]:

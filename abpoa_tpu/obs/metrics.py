@@ -45,6 +45,14 @@ _ENABLED = os.environ.get("ABPOA_TPU_METRICS", "1") not in ("0", "off")
 
 NAMESPACE = "abpoa"
 
+# serializes the mutate paths (Counter.inc / Gauge.set / sketch.observe):
+# read-modify-write under the GIL can interleave between threads, and
+# `abpoa-tpu serve` is the first concurrent publisher (N handler threads
+# + workers). One process-wide RLock — uncontended acquire is ~100 ns
+# against per-event work in the µs-ms range; render paths keep their
+# existing snapshot-under-GIL strategy and never hold this lock.
+_MUT = threading.RLock()
+
 
 def enabled() -> bool:
     return _ENABLED
@@ -92,28 +100,30 @@ class LogSketch:
         self.max = -math.inf
 
     def observe(self, v: float) -> None:
-        if v < self.min:
-            self.min = v
-        if v > self.max:
-            self.max = v
-        self.count += 1
-        self.sum += v
-        if v <= self.LO:
-            i = 0
-        else:
-            i = int((math.log(v) - self._LOG_LO) / self._LOG_G)
-            if i >= self.N_BUCKETS:
-                i = self.N_BUCKETS - 1
-        self.counts[i] += 1
+        with _MUT:
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            self.count += 1
+            self.sum += v
+            if v <= self.LO:
+                i = 0
+            else:
+                i = int((math.log(v) - self._LOG_LO) / self._LOG_G)
+                if i >= self.N_BUCKETS:
+                    i = self.N_BUCKETS - 1
+            self.counts[i] += 1
 
     def merge(self, other: "LogSketch") -> None:
         """Bucket-wise merge (cross-run / cross-shard aggregation)."""
-        for i, c in enumerate(other.counts):
-            self.counts[i] += c
-        self.count += other.count
-        self.sum += other.sum
-        self.min = min(self.min, other.min)
-        self.max = max(self.max, other.max)
+        with _MUT:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+            self.count += other.count
+            self.sum += other.sum
+            self.min = min(self.min, other.min)
+            self.max = max(self.max, other.max)
 
     def quantile(self, q: float) -> Optional[float]:
         """Nearest-rank quantile estimate within RELATIVE_ERROR."""
@@ -165,7 +175,8 @@ class Counter:
 
     def inc(self, n: float = 1, **labels) -> None:
         key = tuple(sorted(labels.items()))
-        self.values[key] = self.values.get(key, 0) + n
+        with _MUT:
+            self.values[key] = self.values.get(key, 0) + n
 
     def value(self, **labels) -> float:
         return self.values.get(tuple(sorted(labels.items())), 0)
@@ -188,7 +199,8 @@ class Gauge(Counter):
     __slots__ = ()
 
     def set(self, v: float, **labels) -> None:
-        self.values[tuple(sorted(labels.items()))] = v
+        with _MUT:
+            self.values[tuple(sorted(labels.items()))] = v
 
 
 class Histogram:
@@ -310,14 +322,19 @@ class MetricsRegistry:
             g.set(round((ops - prev[3]) / dt / peak, 6))
 
     def _update_quantile_gauges(self) -> None:
-        h = self._families.get("abpoa_read_wall_seconds")
-        if h is None or h.sketch.count == 0:
-            return
-        g = self.gauge("abpoa_read_wall_seconds_quantile",
-                       "Sketch-estimated per-read wall quantiles "
-                       "(textfile-exporter convenience for `top`)")
-        for q, label in ((0.5, "0.5"), (0.95, "0.95"), (0.99, "0.99")):
-            g.set(round(h.quantile(q), 9), quantile=label)
+        for base, help_ in (
+                ("abpoa_read_wall_seconds",
+                 "Sketch-estimated per-read wall quantiles "
+                 "(textfile-exporter convenience for `top`)"),
+                ("abpoa_serve_request_seconds",
+                 "Sketch-estimated request-latency quantiles "
+                 "(textfile-exporter convenience for `top`)")):
+            h = self._families.get(base)
+            if h is None or h.sketch.count == 0:
+                continue
+            g = self.gauge(base + "_quantile", help_)
+            for q, label in ((0.5, "0.5"), (0.95, "0.95"), (0.99, "0.99")):
+                g.set(round(h.quantile(q), 9), quantile=label)
 
     def render(self) -> str:
         """The Prometheus text exposition (format version 0.0.4)."""
@@ -418,6 +435,15 @@ _BREAKER_PREFIXES = {
                          "Classified dispatch failures by backend"),
     "breaker.open": ("abpoa_breaker_opens_total",
                      "Circuit-breaker open events by backend"),
+    "breaker.half_open": ("abpoa_breaker_half_open_probes_total",
+                          "Cooldown-expiry half-open probe dispatches by "
+                          "backend"),
+    "breaker.reclose": ("abpoa_breaker_recloses_total",
+                        "Circuit-breaker reclose events (successful "
+                        "half-open probes) by backend"),
+    "breaker.probe_fail": ("abpoa_breaker_probe_failures_total",
+                           "Half-open probes that failed and reopened "
+                           "the breaker, by backend"),
 }
 
 
@@ -507,7 +533,47 @@ def bump_batch_set_done() -> None:
     g = _REGISTRY.gauge(
         "abpoa_batch_sets_done",
         "Read sets completed in the current -l/batch run")
-    g.set(g.value() + 1)
+    with _MUT:  # read-modify-write spans two calls (RLock re-enters)
+        g.set(g.value() + 1)
+
+
+# ------------------------------------------------------------- serve hooks
+
+def publish_serve_request(status: str, wall_s: float) -> None:
+    """One terminal serve-request disposition: `status` is the admission/
+    execution verdict (ok | rejected | poisoned | timeout | draining |
+    error), `wall_s` the whole-request latency (admission wait included).
+    Single definition site for the serve counters the ISSUE-12 soak and
+    `top`'s serve panel read."""
+    if not _ENABLED:
+        return
+    _REGISTRY.counter(
+        "abpoa_serve_requests_total",
+        "Serve requests by terminal status").inc(1, status=status)
+    _REGISTRY.histogram(
+        "abpoa_serve_request_seconds",
+        "End-to-end request latency (log-bucket sketch, "
+        f"~{int(LogSketch.RELATIVE_ERROR * 100)}% quantile tolerance)"
+    ).observe(wall_s)
+
+
+def publish_serve_admitted() -> None:
+    if _ENABLED:
+        _REGISTRY.counter("abpoa_serve_admitted_total",
+                          "Requests admitted into the serve queue").inc(1)
+
+
+def publish_serve_state(queue_depth: int, inflight: int) -> None:
+    """Live queue-depth / in-flight gauges (published on every admission
+    and completion event — both are O(1) dict writes)."""
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge("abpoa_serve_queue_depth",
+                    "Requests waiting in the serve admission queue").set(
+        queue_depth)
+    _REGISTRY.gauge("abpoa_serve_inflight",
+                    "Requests currently executing in serve workers").set(
+        inflight)
 
 
 def clear_batch_progress() -> None:

@@ -107,18 +107,24 @@ class RunReport:
             yield
         finally:
             dt = time.perf_counter() - t0
-            rec = self.phases.get(name)
-            if rec is None:
-                self.phases[name] = [dt, 1]
-            else:
-                rec[0] += dt
-                rec[1] += 1
+            # metrics._MUT (RLock) also guards this report's accumulators:
+            # serve workers are concurrent publishers of BOTH surfaces,
+            # and an unlocked read-modify-write here would make the final
+            # report disagree with the (locked) Prometheus counters
+            with _metrics._MUT:
+                rec = self.phases.get(name)
+                if rec is None:
+                    self.phases[name] = [dt, 1]
+                else:
+                    rec[0] += dt
+                    rec[1] += 1
             _trace.add_span(name, "phase", t0, dt)
             _metrics.publish_phase(name, dt)
 
     def count(self, name: str, n: int = 1) -> None:
         if self.enabled:
-            self.counters[name] = self.counters.get(name, 0) + n
+            with _metrics._MUT:
+                self.counters[name] = self.counters.get(name, 0) + n
             # mirror into the process-cumulative fleet registry (curated
             # Prometheus families; names outside the map stay run-local)
             _metrics.publish_counter(name, n)
@@ -128,16 +134,17 @@ class RunReport:
         bucket bookkeeping in the hot path."""
         if not self.enabled:
             return
-        rec = self.values.get(name)
-        if rec is None:
-            self.values[name] = [1, value, value, value]
-        else:
-            rec[0] += 1
-            rec[1] += value
-            if value < rec[2]:
-                rec[2] = value
-            if value > rec[3]:
-                rec[3] = value
+        with _metrics._MUT:
+            rec = self.values.get(name)
+            if rec is None:
+                self.values[name] = [1, value, value, value]
+            else:
+                rec[0] += 1
+                rec[1] += value
+                if value < rec[2]:
+                    rec[2] = value
+                if value > rec[3]:
+                    rec[3] = value
 
     def record_dp(self, rows: int, band_cols: int, gap_mode: int) -> None:
         """Account one DP dispatch: band extent and cell totals, so reads/s
@@ -171,20 +178,23 @@ class RunReport:
         if not self.enabled:
             return
         # the sketch and the attribution dicts see EVERY read (O(1) each);
-        # only the raw record list is capped
-        self.wall_sketch.observe(wall_s)
-        self.read_backends[backend] = self.read_backends.get(backend, 0) + 1
-        if fallback:
-            self.read_fallbacks[fallback] = \
-                self.read_fallbacks.get(fallback, 0) + 1
-        if amortized:
-            self.reads_amortized += 1
+        # only the raw record list is capped. One lock spans the whole
+        # record so concurrent serve workers keep count/sketch consistent
+        with _metrics._MUT:
+            self.wall_sketch.observe(wall_s)
+            self.read_backends[backend] = \
+                self.read_backends.get(backend, 0) + 1
+            if fallback:
+                self.read_fallbacks[fallback] = \
+                    self.read_fallbacks.get(fallback, 0) + 1
+            if amortized:
+                self.reads_amortized += 1
+            if len(self.reads) < READS_CAP:
+                self.reads.append((wall_s, qlen, band_cols, backend,
+                                   fallback, amortized))
+            else:
+                self.reads_dropped += 1
         _metrics.publish_read(wall_s, backend, fallback)
-        if len(self.reads) < READS_CAP:
-            self.reads.append((wall_s, qlen, band_cols, backend, fallback,
-                               amortized))
-        else:
-            self.reads_dropped += 1
 
     def record_fault(self, kind: str, backend: Optional[str] = None,
                      set_index: Optional[int] = None, detail: str = "",
@@ -197,9 +207,6 @@ class RunReport:
         if not self.enabled:
             return
         self.count(f"faults.{kind}")
-        if len(self.faults) >= FAULTS_CAP:
-            self.faults_dropped += 1
-            return
         rec = {"kind": kind, "t_s": round(time.perf_counter() - self.t_start,
                                           4)}
         if backend:
@@ -210,15 +217,26 @@ class RunReport:
             rec["detail"] = detail
         if action:
             rec["action"] = action
-        self.faults.append(rec)
+        with _metrics._MUT:
+            if len(self.faults) >= FAULTS_CAP:
+                self.faults_dropped += 1
+            else:
+                self.faults.append(rec)
 
     def mark_degraded(self, backend: str, to: str, reason: str,
                       failures: int) -> None:
-        """A circuit-breaker open: `backend` serves as `to` for the rest
-        of the run (resilience/breaker.py is the single caller)."""
+        """A circuit-breaker open: `backend` serves as `to` until the
+        breaker recloses (resilience/breaker.py is the single caller)."""
         if self.enabled:
             self.degraded[backend] = {"to": to, "reason": reason,
                                       "failures": failures}
+
+    def mark_reclosed(self, backend: str) -> None:
+        """A half-open probe succeeded: the backend left the `degraded`
+        block (which reports breakers open NOW, not historically — the
+        open/reclose history lives in the breaker.* counters)."""
+        if self.enabled:
+            self.degraded.pop(backend, None)
 
     # ----------------------------------------------------------- rendering
     def _faults_block(self) -> Optional[dict]:

@@ -90,6 +90,24 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
     lines.append(f"dp       {_fmt_si(cells):>9} cells  "
                  f"{_fmt_si(cups):>8}/s CUPS{mfu_s}")
 
+    # serve panel (present only when an `abpoa-tpu serve` process feeds
+    # the exporter): admission state + per-status dispositions + request
+    # latency quantiles
+    statuses = _labeled(samples, "abpoa_serve_requests_total", "status")
+    qdepth = M.sample_value(samples, "abpoa_serve_queue_depth")
+    if statuses or qdepth is not None:
+        inflight = M.sample_value(samples, "abpoa_serve_inflight") or 0
+        disp = "  ".join(f"{k}={v:.0f}" for k, v in sorted(statuses.items()))
+        lines.append(f"serve    queue {qdepth or 0:.0f}  inflight "
+                     f"{inflight:.0f}  {disp}")
+        sq = _labeled(samples, "abpoa_serve_request_seconds_quantile",
+                      "quantile")
+        if sq:
+            lines.append("         req ms  p50 {:.2f}  p95 {:.2f}  "
+                         "p99 {:.2f}".format(
+                             1e3 * sq.get("0.5", 0), 1e3 * sq.get("0.95", 0),
+                             1e3 * sq.get("0.99", 0)))
+
     # phase split
     phases = _labeled(samples, "abpoa_phase_wall_seconds_total", "phase")
     tot = sum(phases.values())

@@ -1,1 +1,2 @@
-from .runner import lockstep_enabled, run_batch, shard_dp_batch
+from .runner import (flush_lockstep_group, lockstep_enabled,
+                     lockstep_group_size, run_batch, shard_dp_batch)

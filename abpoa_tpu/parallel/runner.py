@@ -76,10 +76,14 @@ def _lockstep_ok(abpt: Params) -> bool:
             and fused_config_eligible(abpt))
 
 
-def _flush_group(group: List, abpt: Params, devices: List, gi: int) -> dict:
-    """Run one lockstep group; returns {file_idx: Abpoa-with-finished-graph}.
-    Entries absent from the result (whole-batch failure, or a per-set device
-    failure) take the sequential path."""
+def flush_lockstep_group(group: List, abpt: Params, devices: List,
+                         gi: int) -> dict:
+    """Run one lockstep group of (idx, ab, seqs, weights) entries; returns
+    {idx: Abpoa-with-finished-graph}. Entries absent from the result
+    (whole-batch failure, or a per-set device failure) take the sequential
+    path. Shared by the `-l` batch segments below and the serve
+    coalescer (abpoa_tpu/serve): both pack same-rung read sets into one
+    vmapped dispatch per group."""
     if not group:
         return {}
     import jax
@@ -248,7 +252,7 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
 
     def emit_segment() -> None:
         nonlocal gi
-        results = _flush_group(group, abpt, devices, gi)
+        results = flush_lockstep_group(group, abpt, devices, gi)
         gi += 1
         for idx, fn in seg:
             if idx in results:

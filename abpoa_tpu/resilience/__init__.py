@@ -132,16 +132,20 @@ def guarded_device_call(label: str, backend: str, fn: Callable,
         return fn()
     from ..obs import count
     br = breaker()
-    if br.is_open(backend):
-        # the demotion is already decided: fail fast to the caller's
-        # fallback path instead of re-paying the first attempt (on a
-        # wedged accelerator that attempt is a full watchdog deadline
-        # per dispatch — hours over a long `-l` run)
+    # acquire() is the half-open gate: "closed" dispatches normally,
+    # "probe" means THIS call is the single cooldown probe of an open
+    # breaker, None means the demotion stands — fail fast to the caller's
+    # fallback path instead of re-paying the first attempt (on a wedged
+    # accelerator that attempt is a full watchdog deadline per dispatch —
+    # hours over a long `-l` run)
+    permit = br.acquire(backend)
+    if permit is None:
         count("breaker.short_circuit")
         raise DispatchFailed(
             "breaker_open",
             f"{label}: circuit breaker open for '{backend}' "
             f"(serving as '{br.effective(backend)}')")
+    is_probe = permit == "probe"
     # supervision costs a worker thread (and XLA:CPU compiles run ~2x
     # slower off the main thread, PERF.md round 9): arm it only where a
     # hang is possible — real accelerator platforms — or demanded
@@ -159,17 +163,31 @@ def guarded_device_call(label: str, backend: str, fn: Callable,
     for i in range(tries):
         try:
             if supervised:
-                return watchdog.call_with_deadline(attempt, deadline_s,
-                                                   label=label)
-            return attempt()
+                result = watchdog.call_with_deadline(attempt, deadline_s,
+                                                     label=label)
+            else:
+                result = attempt()
+            # recloses a half-open breaker when this call holds the probe
+            # permit; a no-op for everyone else (a stale pre-open dispatch
+            # must not reclose on another thread's behalf)
+            br.record_success(backend, probe=is_probe)
+            return result
         except Exception as e:  # noqa: BLE001 — classified, unknowns re-raise
             cls = classify(e)
             if cls is None:
+                # unclassified = real bug: release OUR held probe permit
+                # so the breaker cannot wedge in "probing" forever, then
+                # let the exception surface
+                if is_probe:
+                    br.abort_probe(backend)
                 raise
             kind, retryable, breaks = cls
             last_exc, last_kind = e, kind
             if breaks:
-                br.record_failure(backend, kind)
+                br.record_failure(backend, kind, probe=is_probe)
+            elif is_probe:
+                # a non-breaker fault (fused_bail) still ends our probe
+                br.abort_probe(backend)
             # no retry once the breaker opened: the demotion is decided
             retrying = retryable and i + 1 < tries and not br.is_open(backend)
             if kind == "fused_bail":

@@ -3,20 +3,36 @@
 Generalizes the probe-timeout special case (utils/probe.py falls back once,
 at resolution time) into a run-scoped policy: every classified dispatch
 failure is recorded against the backend that failed; past a threshold the
-breaker OPENS and the backend is demoted for the remainder of the run —
-pallas -> jax -> native -> numpy — instead of re-failing (and re-paying
-retries, watchdog deadlines, or re-compiles) on every subsequent read.
+breaker OPENS and the backend is demoted — pallas -> jax -> native ->
+numpy — instead of re-failing (and re-paying retries, watchdog deadlines,
+or re-compiles) on every subsequent read.
 
-Openings are never silent: each one warns on stderr once, increments
-`breaker.open.<backend>`, and lands in the run report's `degraded` block
-(schema v3). `obs.start_run()` resets the breaker, so demotion is per-run
-state, exactly like the probe verdict's telemetry labels.
+Recovery (the long-lived-process story `abpoa-tpu serve` depends on): an
+open breaker is not open forever. After ``ABPOA_TPU_BREAKER_COOLDOWN_S``
+(default 300 s) the breaker goes HALF-OPEN: exactly one dispatch is allowed
+through as a probe (`acquire` hands out the single permit; every other
+caller keeps short-circuiting to the demoted backend while the probe is in
+flight). A successful probe RECLOSES the breaker — the backend is
+reclaimed, its failure count zeroed — while a failed probe reopens it and
+restarts the cooldown. Batch runs never notice (a run is usually shorter
+than the cooldown); a serve process that lost pallas/jax to a transient
+tunnel fault gets it back without a restart.
+
+State transitions are never silent: opens/recloses warn on stderr once,
+tick `breaker.open.<backend>` / `breaker.reclose.<backend>` /
+`breaker.half_open.<backend>`, and land in the run report's `degraded`
+block (schema v3; a reclosed backend leaves the block — it reports
+breakers open NOW). `obs.start_run()` resets the breaker wholesale.
+All transitions hold one lock: server threads race dispatches against
+each other and against the cooldown clock.
 """
 from __future__ import annotations
 
 import os
 import sys
-from typing import Dict
+import threading
+import time
+from typing import Dict, Optional
 
 # the degradation ladder: who serves when a backend is demoted. "numpy"
 # (the host oracle) is the floor and is never demoted — it is the
@@ -29,45 +45,158 @@ def _threshold() -> int:
     return max(1, int(os.environ.get("ABPOA_TPU_BREAKER_THRESHOLD", "3")))
 
 
+def cooldown_s() -> float:
+    """Seconds an open breaker waits before allowing the half-open probe.
+    <= 0 means a probe is allowed immediately (tests); the 300 s default
+    is sized so a batch run never probes but a serve process retries a
+    demoted accelerator a few times an hour."""
+    return float(os.environ.get("ABPOA_TPU_BREAKER_COOLDOWN_S", "300"))
+
+
 class CircuitBreaker:
     def __init__(self) -> None:
         self.failures: Dict[str, int] = {}
-        self.open: Dict[str, dict] = {}   # backend -> {"to", "kind", "failures"}
+        # backend -> {"to", "kind", "failures", "opened_t", "probing"}
+        self.open: Dict[str, dict] = {}
+        self._lock = threading.RLock()
 
     def reset(self) -> None:
         # fleet registry: an open breaker from the previous run reads as
         # closed again the moment the next run starts (run-scoped state)
         from ..obs import metrics
-        for backend in self.open:
-            metrics.set_breaker_state(backend, False)
-        self.failures.clear()
-        self.open.clear()
+        with self._lock:
+            for backend in self.open:
+                metrics.set_breaker_state(backend, False)
+            self.failures.clear()
+            self.open.clear()
+
+    def _demoted_now_locked(self, backend: str) -> bool:
+        """Is this backend demoted RIGHT NOW (cooldown-aware)? False once
+        the cooldown elapsed with no probe in flight — the next `acquire`
+        will claim the probe permit. Callers hold self._lock."""
+        st = self.open.get(backend)
+        if st is None:
+            return False
+        if st["probing"]:
+            return True  # someone else is probing; stay demoted
+        return (time.monotonic() - st["opened_t"]) < cooldown_s()
 
     def is_open(self, backend: str) -> bool:
-        return backend in self.open
+        """Pure state query (no transition)."""
+        with self._lock:
+            return self._demoted_now_locked(backend)
+
+    def acquire(self, backend: str) -> Optional[str]:
+        """Claim the right to dispatch on `backend`.
+
+        "closed"  breaker closed: dispatch normally
+        "probe"   breaker half-open and THIS caller holds the single probe
+                  permit: dispatch, then report success/failure
+        None      breaker open (or a probe is already in flight): short-
+                  circuit to the demoted backend
+        """
+        with self._lock:
+            st = self.open.get(backend)
+            if st is None:
+                return "closed"
+            if st["probing"]:
+                return None
+            if (time.monotonic() - st["opened_t"]) >= cooldown_s():
+                st["probing"] = True
+                from ..obs import count
+                count(f"breaker.half_open.{backend}")
+                return "probe"
+            return None
 
     def effective(self, backend: str) -> str:
-        """Walk the demotion ladder past every open breaker."""
-        seen = set()
-        while backend in self.open and backend not in seen:
-            seen.add(backend)
-            backend = DEMOTION.get(backend, "numpy")
-        return backend
+        """Walk the demotion ladder past every CURRENTLY-demoted breaker.
+        Cooldown-aware on purpose: once a backend's cooldown elapses,
+        resolution (align/dispatch._resolve) names it again, so the next
+        guarded dispatch reaches `acquire()` and can claim the half-open
+        probe — otherwise the per-read path would stay demoted forever
+        and only the fused route could ever recover a backend."""
+        with self._lock:
+            seen = set()
+            while self._demoted_now_locked(backend) and backend not in seen:
+                seen.add(backend)
+                backend = DEMOTION.get(backend, "numpy")
+            return backend
 
-    def record_failure(self, backend: str, kind: str) -> None:
+    def abort_probe(self, backend: str) -> None:
+        """Release a claimed probe permit without a verdict (the probe
+        died on an unclassified exception — a real bug that will
+        propagate). ONLY the permit holder may call this (guarded by the
+        `permit == "probe"` check at the call site): a stale closed-era
+        dispatch must not reset another thread's probe. The breaker stays
+        open and the cooldown restarts, so the stuck-probing state can
+        never outlive its dispatch."""
+        with self._lock:
+            st = self.open.get(backend)
+            if st is not None and st["probing"]:
+                st["probing"] = False
+                st["opened_t"] = time.monotonic()
+
+    def record_success(self, backend: str, probe: bool = False) -> None:
+        """A dispatch on `backend` completed healthy. With `probe=True`
+        (the caller holds the half-open permit) a success RECLOSES the
+        breaker; without it this is a no-op — a dispatch that started
+        before the breaker opened proves nothing about recovery, and must
+        not reclose on behalf of someone else's in-flight probe."""
+        if not probe:
+            return
+        with self._lock:
+            st = self.open.get(backend)
+            if st is None or not st["probing"]:
+                return
+            del self.open[backend]
+            self.failures[backend] = 0
         from ..obs import count, report
-        n = self.failures[backend] = self.failures.get(backend, 0) + 1
-        count(f"breaker.failures.{backend}")
-        if n >= _threshold() and backend not in self.open:
+        count(f"breaker.reclose.{backend}")
+        report().mark_reclosed(backend)
+        from ..obs import metrics
+        metrics.set_breaker_state(backend, False)
+        print(f"Warning: backend '{backend}' circuit breaker reclosed "
+              "(half-open probe succeeded); resuming normal dispatch.",
+              file=sys.stderr)
+
+    def record_failure(self, backend: str, kind: str,
+                       probe: bool = False) -> None:
+        from ..obs import count, report
+        with self._lock:
+            st = self.open.get(backend)
+            if st is not None:
+                if probe:
+                    # the half-open probe failed: reopen, restart the
+                    # cooldown, keep the demotion in force
+                    st["probing"] = False
+                    st["opened_t"] = time.monotonic()
+                    st["kind"] = kind
+                    st["failures"] += 1
+                    count(f"breaker.probe_fail.{backend}")
+                    report().mark_degraded(backend, st["to"], kind,
+                                           st["failures"])
+                else:
+                    # a stale dispatch that started before the breaker
+                    # opened (or a direct guard-path report): count it,
+                    # but never touch someone else's probe state
+                    count(f"breaker.failures.{backend}")
+                return
+            n = self.failures[backend] = self.failures.get(backend, 0) + 1
+            count(f"breaker.failures.{backend}")
+            if n < _threshold():
+                return
             to = self.effective(DEMOTION.get(backend, "numpy"))
-            self.open[backend] = {"to": to, "kind": kind, "failures": n}
-            count(f"breaker.open.{backend}")
-            report().mark_degraded(backend, to, kind, n)
-            from ..obs import metrics
-            metrics.set_breaker_state(backend, True)
-            print(f"Warning: backend '{backend}' circuit breaker opened "
-                  f"after {n} dispatch failures (last: {kind}); using "
-                  f"'{to}' for the remainder of the run.", file=sys.stderr)
+            self.open[backend] = {"to": to, "kind": kind, "failures": n,
+                                  "opened_t": time.monotonic(),
+                                  "probing": False}
+        count(f"breaker.open.{backend}")
+        report().mark_degraded(backend, to, kind, n)
+        from ..obs import metrics
+        metrics.set_breaker_state(backend, True)
+        print(f"Warning: backend '{backend}' circuit breaker opened "
+              f"after {n} dispatch failures (last: {kind}); using "
+              f"'{to}' until the {cooldown_s():.0f}s cooldown allows a "
+              "probe.", file=sys.stderr)
 
 
 _BREAKER = CircuitBreaker()

@@ -1,0 +1,46 @@
+"""`abpoa-tpu serve`: the persistent, fault-contained aligner service.
+
+ROADMAP item 1's front end over the substrate PRs 7-10 built: a
+stdlib-first HTTP server (ThreadingHTTPServer, the `--metrics-port`
+idiom — no framework dependency) that accepts read-set alignment jobs
+and stays correct and alive under overload and injected faults.
+
+The robustness contract, mechanism by mechanism:
+
+- **Admission** (admission.py): a bounded queue priced by
+  `resilience/memory.py`'s DP-plane byte model. Past the queue depth or
+  the byte budget a request is shed as 429 + Retry-After — the server
+  never OOMs discovering its limit.
+- **Deadlines**: every request carries one (default
+  ``ABPOA_TPU_SERVE_DEADLINE_S``, per-request override via the
+  ``X-Abpoa-Deadline-S`` header, capped by the server's). Expiry rides
+  the `resilience/watchdog.py` envelope: the request answers 504 with a
+  fault record and the executing thread is abandoned, not joined — a
+  wedged alignment can never wedge a worker.
+- **Coalescing** (server.py): queued requests are grouped by their
+  declared `compile/ladder.py` Qp rung, so arriving sets pack into
+  shapes the startup AOT warm already compiled (zero-recompile steady
+  state); on an accelerator mesh a same-rung group runs as ONE vmapped
+  lockstep dispatch (`parallel.flush_lockstep_group`).
+- **Isolation**: a poisoned set (malformed records, injected
+  `poison_set`) is a 400 for that request — `quarantine.py` semantics,
+  never a crashed worker; an unexpected execution error is a 500 plus a
+  fault record, and the worker survives.
+- **Degradation**: dispatch failures flow through the circuit breaker
+  exactly as in batch runs; `/healthz` reports degraded-but-serving and
+  the half-open cooldown (resilience/breaker.py) reclaims a demoted
+  backend without a restart.
+- **Drain**: SIGTERM/SIGINT stops admission (new requests get 503),
+  finishes in-flight work, flushes metrics and the report archive, and
+  exits 0.
+
+Each terminal request lands one `obs/archive.py` record, so
+`abpoa-tpu slo` evaluates the served window the same way it evaluates
+batch runs; `tools/loadgen.py` + `tools/serve_smoke.py` are the measured
+proof (CI `serve-smoke`).
+"""
+from .admission import AdmissionController, Job, request_caps
+from .server import AlignServer, serve_main
+
+__all__ = ["AdmissionController", "Job", "request_caps", "AlignServer",
+           "serve_main"]

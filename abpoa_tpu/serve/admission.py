@@ -1,0 +1,229 @@
+"""Bounded, memory-priced admission for the serve front end.
+
+Two limits, both checked BEFORE a request costs anything:
+
+- queue depth (``ABPOA_TPU_SERVE_QUEUE``, default 64): the knee of the
+  open-loop overload curve. Arrivals past a full queue are shed as 429 —
+  latency stays bounded instead of building an unbounded backlog.
+- DP-plane bytes (``ABPOA_TPU_SERVE_MEM_BUDGET_MB``): each request is
+  priced with `resilience/memory.py`'s footprint model over its
+  compile-ladder rung (the same arithmetic the dispatch admission uses),
+  and the sum over queued + in-flight requests must fit the budget. A
+  single request is always admissible on an empty system — at dispatch
+  time `memory.admit` still chunks or demotes it if it alone exceeds the
+  device budget — so the byte gate bounds *concurrency*, it can never
+  wedge the service on one large set.
+
+Rejections carry a Retry-After derived from the observed service rate
+(queue depth x recent mean service time), so a well-behaved client backs
+off proportionally to the actual backlog.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from ..resilience import memory
+
+
+def queue_limit() -> int:
+    return max(1, int(os.environ.get("ABPOA_TPU_SERVE_QUEUE", "64")))
+
+
+def default_deadline_s() -> float:
+    """Per-request wall deadline (admission wait + execution). Sized for
+    warm rungs: a cold first-sight compile belongs to startup warm, not
+    to a request."""
+    return float(os.environ.get("ABPOA_TPU_SERVE_DEADLINE_S", "30"))
+
+
+def serve_budget_bytes() -> Optional[int]:
+    """Byte budget over queued + in-flight DP planes. Defaults to the
+    dispatch-layer budget when one is active (accelerator HBM), else a
+    4 GB host-RAM bound; 0 disables the byte gate (depth still holds)."""
+    env = os.environ.get("ABPOA_TPU_SERVE_MEM_BUDGET_MB")
+    if env is not None:
+        mb = float(env)
+        return int(mb * 1e6) if mb > 0 else None
+    return memory.budget_bytes() or 4_000 * 10 ** 6
+
+
+def request_caps(abpt, records) -> dict:
+    """The compile-ladder rung caps one request's dispatch would start
+    from. Qp/W/N come from the SAME definition site the fused planner
+    reads (`compile.ladder.plan_chunk_buckets`/`chunk_node_cap` — jax-
+    free, so a host-device serve process prices admission without a jax
+    import), so the byte gate cannot drift from the dispatched shapes.
+    plane16 is left False: one cell-width step of conservatism in a
+    model that only needs to be right within ~2x."""
+    from ..compile.ladder import (chunk_node_cap, plan_chunk_buckets,
+                                  reads_rung)
+    qmax = max((len(r.seq) for r in records), default=1)
+    Qp, W, _local = plan_chunk_buckets(abpt, qmax)
+    return dict(N=chunk_node_cap(qmax), E=8, A=8, W=W, Qp=Qp,
+                reads=reads_rung(max(1, len(records))), K=1, plane16=False,
+                gap_mode=abpt.gap_mode, m=abpt.m)
+
+
+class Job:
+    """One admitted alignment request moving through the queue."""
+
+    _ids = itertools.count(1)
+
+    __slots__ = ("id", "label", "records", "n_reads", "rung", "est_bytes",
+                 "eligible", "deadline_s", "t_arrive", "done", "status",
+                 "body", "error", "_lock", "_done_marked")
+
+    def __init__(self, records, rung: int, est_bytes: int, eligible: bool,
+                 deadline_s: float) -> None:
+        self.id = next(self._ids)
+        self.label = f"req-{self.id}"
+        self.records = records
+        self.n_reads = len(records)
+        self.rung = rung
+        self.est_bytes = est_bytes
+        self.eligible = eligible
+        self.deadline_s = deadline_s
+        self.t_arrive = time.perf_counter()
+        self.done = threading.Event()
+        self.status: Optional[str] = None
+        self.body = ""
+        self.error = ""
+        self._lock = threading.Lock()
+        self._done_marked = False   # owned by AdmissionController._cv
+
+    def remaining_s(self) -> float:
+        return self.deadline_s - (time.perf_counter() - self.t_arrive)
+
+    def wall_s(self) -> float:
+        return time.perf_counter() - self.t_arrive
+
+    def finish(self, status: str, body: str = "", error: str = "") -> bool:
+        """First writer wins: the worker and the handler's safety-net
+        timeout can both try to conclude a job; exactly one does."""
+        with self._lock:
+            if self.status is not None:
+                return False
+            self.status = status
+            self.body = body
+            self.error = error
+        self.done.set()
+        return True
+
+
+class AdmissionController:
+    """The bounded queue + its accounting. All state under one condition
+    variable; every mutation republishes the queue/inflight gauges."""
+
+    def __init__(self, abpt, max_depth: Optional[int] = None,
+                 budget_bytes: Optional[int] = None) -> None:
+        self._abpt = abpt
+        self._cv = threading.Condition()
+        self._queue: Deque[Job] = deque()
+        self._max_depth = max_depth if max_depth is not None else queue_limit()
+        self._budget = (budget_bytes if budget_bytes is not None
+                        else serve_budget_bytes())
+        self._bytes = 0          # queued + in-flight estimate
+        self._inflight = 0
+        self._closed = False
+        self._service_ewma_s = 0.05   # Retry-After seed, updated on done
+
+    # ------------------------------------------------------------- intake
+    def try_admit(self, job: Job) -> Tuple[bool, str, float]:
+        """-> (admitted, reason, retry_after_s). Reasons: "", "draining",
+        "queue_full", "memory"."""
+        from ..obs import metrics
+        with self._cv:
+            if self._closed:
+                return False, "draining", 0.0
+            if len(self._queue) >= self._max_depth:
+                return False, "queue_full", self._retry_after_locked()
+            if (self._budget and self._bytes > 0
+                    and self._bytes + job.est_bytes > self._budget):
+                return False, "memory", self._retry_after_locked()
+            self._queue.append(job)
+            self._bytes += job.est_bytes
+            self._publish_locked()
+            self._cv.notify()
+        metrics.publish_serve_admitted()
+        return True, "", 0.0
+
+    def _retry_after_locked(self) -> float:
+        # the backlog's expected drain time: what a 429 tells the client
+        backlog = len(self._queue) + self._inflight
+        return max(1.0, round(backlog * self._service_ewma_s, 1))
+
+    def close_intake(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ------------------------------------------------------------- workers
+    def next_group(self, max_k: int = 1, coalesce: bool = False,
+                   timeout: float = 0.25) -> List[Job]:
+        """Pop the head job, plus (when coalescing) up to max_k-1 more
+        queued jobs sharing its Qp rung — the lockstep pack. Returns []
+        on timeout or closed-and-empty so workers can re-check shutdown."""
+        with self._cv:
+            if not self._queue:
+                if self._closed:
+                    return []
+                self._cv.wait(timeout)
+                if not self._queue:
+                    return []
+            head = self._queue.popleft()
+            group = [head]
+            if coalesce and head.eligible and max_k > 1:
+                for job in list(self._queue):
+                    if len(group) >= max_k:
+                        break
+                    if job.eligible and job.rung == head.rung:
+                        self._queue.remove(job)
+                        group.append(job)
+            self._inflight += len(group)
+            self._publish_locked()
+            return group
+
+    def mark_done(self, job: Job, service_s: Optional[float] = None) -> None:
+        """Release one job's accounting. Idempotent per job: the worker's
+        catch-all sweep can overlap the per-job finally blocks, and a
+        double release would drive _inflight/_bytes negative — silently
+        disabling the byte gate and wedging wait_drained."""
+        with self._cv:
+            if job._done_marked:
+                return
+            job._done_marked = True
+            self._bytes -= job.est_bytes
+            self._inflight -= 1
+            if service_s is not None:
+                self._service_ewma_s += 0.2 * (service_s
+                                               - self._service_ewma_s)
+            self._publish_locked()
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- state
+    def _publish_locked(self) -> None:
+        from ..obs import metrics
+        metrics.publish_serve_state(len(self._queue), self._inflight)
+
+    def snapshot(self) -> Tuple[int, int]:
+        with self._cv:
+            return len(self._queue), self._inflight
+
+    def drained(self) -> bool:
+        with self._cv:
+            return not self._queue and self._inflight == 0
+
+    def wait_drained(self, timeout: float) -> bool:
+        """Block until queue + in-flight are empty (the drain barrier)."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: not self._queue and self._inflight == 0, timeout)

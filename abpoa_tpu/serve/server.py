@@ -1,0 +1,712 @@
+"""The serve front end: HTTP endpoints, worker pool, drain machinery.
+
+Endpoints (stdlib http.server, one ThreadingHTTPServer):
+
+- ``POST /align``   body = FASTA/FASTQ text, response = the same bytes
+                    the CLI would write for that input (consensus/MSA/GFA
+                    per the server's configured mode). Status codes ARE
+                    the robustness contract: 200 aligned, 400 poisoned
+                    set, 413 oversized body, 429 shed (Retry-After
+                    header), 503 draining, 504 deadline expired.
+                    ``X-Abpoa-Deadline-S`` caps this request tighter
+                    than the server default.
+- ``GET /healthz``  liveness + the degradation story: 200 always while
+                    the process lives, JSON body with status
+                    ok|degraded|draining, open breakers, queue depth,
+                    in-flight and per-status served counts.
+- ``GET /readyz``   admission readiness: 200 once warmed and admitting,
+                    503 while warming or draining (the LB drain signal).
+- ``GET /metrics``  Prometheus exposition (obs/metrics.py registry).
+
+Worker model: N daemon workers pull coalesced same-rung groups from the
+admission queue. Execution always happens under a watchdog deadline
+(`resilience/watchdog.call_with_deadline`): expiry answers 504 and
+abandons the executing thread — a wedged alignment never wedges the
+worker, which moves on to the next request. Every terminal disposition
+publishes `abpoa_serve_requests_total{status}` + the request-latency
+sketch and appends one archive record for `abpoa-tpu slo`.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import io
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..params import Params
+from .admission import (AdmissionController, Job, default_deadline_s,
+                        request_caps)
+
+DEFAULT_PORT = 8673
+
+
+def drain_grace_s() -> float:
+    """How long SIGTERM waits for queued + in-flight work before giving
+    up and exiting anyway (still rc=0: by then every answerable request
+    has been answered or timed out)."""
+    return float(os.environ.get("ABPOA_TPU_SERVE_DRAIN_S", "30"))
+
+
+def max_body_bytes() -> int:
+    return int(float(os.environ.get("ABPOA_TPU_SERVE_MAX_BODY_MB", "32"))
+               * 1e6)
+
+
+def _test_delay_s() -> float:
+    """Artificial per-request service time (ABPOA_TPU_SERVE_DELAY_S) —
+    the load/drain-test shim, same spirit as ABPOA_TPU_INJECT_HANG_S:
+    makes "a request is in flight" a deterministic window instead of a
+    race against a millisecond alignment."""
+    return float(os.environ.get("ABPOA_TPU_SERVE_DELAY_S", "0"))
+
+
+def _request_record(job: Job, status: str, device: str) -> dict:
+    """One archive record per terminal request — the field shapes
+    `obs/slo.py` evaluates (reads, read_wall_ms, faults, total_wall_s),
+    so a served window answers `abpoa-tpu slo` exactly like a batch
+    window. 400/504 count one fault; a 429/503 is load shedding doing
+    its job, not a fault."""
+    wall = job.wall_s()
+    per_read_ms = (round(1e3 * wall / job.n_reads, 4) if job.n_reads
+                   and status == "ok" else None)
+    return {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kind": "serve_request",
+        "label": job.label,
+        "device": device,
+        "status": status,
+        "total_wall_s": round(wall, 6),
+        "reads": job.n_reads if status == "ok" else 0,
+        "read_wall_ms": ({"p50": per_read_ms, "p95": per_read_ms,
+                          "p99": per_read_ms, "amortized": True}
+                         if per_read_ms is not None else None),
+        "faults": 1 if status in ("poisoned", "timeout", "error") else 0,
+        "quarantined": 1 if status == "poisoned" else 0,
+    }
+
+
+class AlignServer:
+    """Owns the admission queue, the worker pool and the HTTP front.
+    `start()` binds + warms + marks ready; `begin_drain()`/`drain()` is
+    the SIGTERM path; `stop()` is the test-friendly full teardown."""
+
+    def __init__(self, abpt: Params, host: str = "127.0.0.1", port: int = 0,
+                 workers: int = 2, queue_depth: Optional[int] = None,
+                 deadline_s: Optional[float] = None) -> None:
+        if not abpt._finalized:
+            abpt = abpt.finalize()
+        self.abpt = abpt
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else default_deadline_s())
+        self.admission = AdmissionController(abpt, max_depth=queue_depth)
+        self.draining = threading.Event()
+        self.ready = threading.Event()
+        self._stats: Dict[str, int] = {}
+        self._stats_lock = threading.Lock()
+        self._workers: List[threading.Thread] = []
+        self._n_workers = max(1, workers)
+        self._devices = None        # jax devices, set after warm
+        self._lockstep = False
+        import itertools
+        self._group_ids = itertools.count()  # atomic across workers
+        self.t_start = time.time()
+        from http.server import ThreadingHTTPServer
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # the default accept backlog (5) drops SYNs under an open-loop
+            # burst long before admission control can answer 429 — shed
+            # load must be shed with a status code, not a TCP reset
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), _make_handler(self))
+        self.host, self.port = self._httpd.server_address[:2]
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, warm: str = "auto") -> None:
+        """Bind is already done (constructor); spin the HTTP thread (so
+        /healthz answers while warming), AOT-warm the ladder, then admit.
+        warm: "quick" | "full" | "off" | "auto" (= quick on device
+        backends, off on host kernels — there is nothing to compile)."""
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="abpoa-serve-http").start()
+        obs.start_run()
+        device_backend = self.abpt.device in ("jax", "tpu", "pallas")
+        if warm == "auto":
+            warm = "quick" if device_backend else "off"
+        if device_backend:
+            from ..utils.probe import apply_platform_pin, jax_backend_reachable
+            if jax_backend_reachable():
+                apply_platform_pin()
+                if warm != "off":
+                    from ..compile import warm_ladder
+                    t0 = time.perf_counter()
+                    summary = warm_ladder(tier=warm, abpt=self.abpt)
+                    print(f"[abpoa-tpu serve] warm({warm}): "
+                          f"{summary['signatures']} signatures, "
+                          f"{summary['compiled']} compiled, "
+                          f"{summary['persistent_cache_hits']} "
+                          f"persistent-cache hits in "
+                          f"{time.perf_counter() - t0:.1f}s",
+                          file=sys.stderr)
+                import jax
+                self._devices = jax.devices()
+                from ..align.eligibility import fused_config_eligible
+                from ..parallel import lockstep_enabled
+                from ..pipeline import plain_route
+                self._lockstep = (lockstep_enabled(self.abpt)
+                                  and plain_route(self.abpt)
+                                  and fused_config_eligible(self.abpt))
+            else:
+                print("[abpoa-tpu serve] Warning: JAX backend probe timed "
+                      "out; serving on the host engine.", file=sys.stderr)
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"abpoa-serve-worker-{i}")
+            t.start()
+            self._workers.append(t)
+        self.ready.set()
+
+    def begin_drain(self) -> None:
+        self.draining.set()
+        self.admission.close_intake()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait for queued + in-flight work to finish; returns True when
+        fully drained within the grace."""
+        if timeout is None:
+            timeout = drain_grace_s()
+        ok = self.admission.wait_drained(timeout)
+        for t in self._workers:
+            t.join(timeout=2.0)
+        return ok
+
+    def shutdown_http(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def stop(self) -> bool:
+        """Full teardown (tests): drain, then close the socket."""
+        self.begin_drain()
+        ok = self.drain()
+        self.shutdown_http()
+        return ok
+
+    # ---------------------------------------------------------- accounting
+    def bump(self, status: str, wall_s: float) -> None:
+        """One terminal disposition that never became a Job (handler-side
+        429/503/parse-400): stats + metric families, no archive record."""
+        from ..obs import metrics
+        with self._stats_lock:
+            self._stats[status] = self._stats.get(status, 0) + 1
+        metrics.publish_serve_request(status, wall_s)
+
+    def account(self, job: Job, status: str) -> None:
+        """Single definition of an admitted job's terminal disposition:
+        per-status stats, the serve metric families, one archive record."""
+        self.bump(status, job.wall_s())
+        obs.archive.append_record(
+            _request_record(job, status, self.abpt.device))
+
+    def stats(self) -> Dict[str, int]:
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def health(self) -> dict:
+        from ..resilience import breaker
+        depth, inflight = self.admission.snapshot()
+        # snapshot first: a half-open probe may reclose (delete a key)
+        # while we iterate
+        degraded = {b: st["to"] for b, st in dict(breaker().open).items()}
+        status = ("draining" if self.draining.is_set()
+                  else "degraded" if degraded else "ok")
+        return {"status": status, "degraded": degraded or None,
+                "queue_depth": depth, "inflight": inflight,
+                "served": self.stats(), "device": self.abpt.device,
+                "uptime_s": round(time.time() - self.t_start, 1)}
+
+    # ---------------------------------------------------------- execution
+    def _worker_loop(self) -> None:
+        from ..parallel import lockstep_group_size
+        max_k = lockstep_group_size() if self._lockstep else 1
+        while True:
+            group = self.admission.next_group(max_k=max_k,
+                                              coalesce=self._lockstep)
+            if not group:
+                # intake closed + queue empty = no work can ever arrive
+                # again: exit NOW, even while a sibling worker still has
+                # a request in flight — spinning here would steal CPU
+                # from the very request the drain is waiting on
+                if (self.admission.closed
+                        and self.admission.snapshot()[0] == 0):
+                    return
+                continue
+            try:
+                self._process_group(group)
+            except BaseException:  # noqa: BLE001 — the worker must survive
+                import traceback
+                traceback.print_exc()
+                for job in group:
+                    if job.finish("error", error="internal worker error"):
+                        self.account(job, "error")
+                    self.admission.mark_done(job)
+
+    def _process_group(self, group: List[Job]) -> None:
+        """Run one coalesced group to terminal status. Never raises for
+        per-request fault shapes: poisoned -> 400, deadline -> 504,
+        anything else -> 500 + fault record, and the worker lives on."""
+        # expire jobs that aged out while queued — their client already
+        # gave up; executing them would burn capacity on dead work
+        live: List[Job] = []
+        for job in group:
+            if job.remaining_s() <= 0:
+                obs.record_fault("request_timeout", detail=job.label,
+                                 action="expired_in_queue")
+                if job.finish("timeout",
+                              error="deadline expired in admission queue"):
+                    self.account(job, "timeout")
+                self.admission.mark_done(job)
+            else:
+                live.append(job)
+        if not live:
+            return
+        # per-group Params copy: msa() mutates its Params (device reroute,
+        # batch bookkeeping) and workers run concurrently
+        abpt = copy.deepcopy(self.abpt)
+        if len(live) > 1:
+            self._run_lockstep(live, abpt)
+            return
+        job = live[0]
+        t0 = time.perf_counter()
+        try:
+            self._finish_single(job, abpt)
+        finally:
+            self.admission.mark_done(job, time.perf_counter() - t0)
+
+    def _finish_single(self, job: Job, abpt: Params) -> None:
+        """Execute ONE job to terminal status under its deadline. No
+        admission bookkeeping here — the caller owns mark_done (the
+        lockstep fallback path re-enters with accounting already open)."""
+        from ..resilience import QUARANTINE_EXCEPTIONS
+        from ..resilience.watchdog import DispatchTimeout, call_with_deadline
+        remaining = job.remaining_s()
+        if remaining <= 0:
+            # the budget is already spent (e.g. a group dispatch consumed
+            # it before this fallback): answer 504 NOW — passing <= 0 to
+            # call_with_deadline would mean "unsupervised", the opposite
+            obs.record_fault("request_timeout", detail=job.label,
+                             action="expired_before_fallback")
+            if job.finish("timeout", error="request deadline expired"):
+                self.account(job, "timeout")
+            return
+        try:
+            body = call_with_deadline(
+                lambda: self._run_single(job, abpt),
+                deadline_s=remaining, label=job.label)
+            if job.finish("ok", body=body):
+                self.account(job, "ok")
+        except DispatchTimeout:
+            obs.record_fault("request_timeout", detail=job.label,
+                            action="worker_abandoned")
+            if job.finish("timeout", error="request deadline expired"):
+                self.account(job, "timeout")
+        except QUARANTINE_EXCEPTIONS as e:
+            # quarantine semantics: a poisoned set is a 400 for THIS
+            # request, never a crashed worker
+            obs.record_fault("poisoned_set", detail=str(e)[:300],
+                            action="rejected_400")
+            if job.finish("poisoned", error=f"{type(e).__name__}: {e}"):
+                self.account(job, "poisoned")
+        except Exception as e:  # noqa: BLE001 — worker must survive
+            obs.record_fault("request_error", detail=str(e)[:300],
+                            action="rejected_500")
+            print(f"[abpoa-tpu serve] {job.label} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            if job.finish("error", error=f"{type(e).__name__}: {e}"):
+                self.account(job, "error")
+
+    def _run_single(self, job: Job, abpt: Params) -> str:
+        from ..pipeline import Abpoa, msa
+        delay = _test_delay_s()
+        if delay:
+            time.sleep(delay)
+        buf = io.StringIO()
+        msa(Abpoa(), abpt, job.records, buf)
+        return buf.getvalue()
+
+    def _run_lockstep(self, jobs: List[Job], abpt: Params) -> None:
+        """Coalesced same-rung group on an accelerator mesh: ingest each
+        request into its own graph container, dispatch ONE vmapped
+        lockstep group (`parallel.flush_lockstep_group` — the exact `-l`
+        batch path, watchdog/breaker/guards included), emit each result
+        independently. Jobs the device path dropped fall back to the
+        sequential runner one by one."""
+        from ..pipeline import Abpoa, _ingest_records, output
+        from ..resilience import QUARANTINE_EXCEPTIONS
+        from ..resilience.watchdog import DispatchTimeout, call_with_deadline
+        from ..parallel import flush_lockstep_group
+        t0 = time.perf_counter()
+        entries = []
+        by_idx: Dict[int, Job] = {}
+        for i, job in enumerate(jobs):
+            try:
+                ab = Abpoa()
+                seqs, weights = _ingest_records(ab, abpt, job.records)
+                entries.append((i, ab, seqs, weights))
+                by_idx[i] = job
+            except QUARANTINE_EXCEPTIONS as e:
+                obs.record_fault("poisoned_set", detail=str(e)[:300],
+                                 action="rejected_400")
+                if job.finish("poisoned", error=f"{type(e).__name__}: {e}"):
+                    self.account(job, "poisoned")
+                self.admission.mark_done(job)
+        if not entries:
+            return
+        gi = next(self._group_ids)
+        # the group dispatch is bounded by the TIGHTEST member's budget
+        # (it must not overshoot anyone's deadline); on expiry only the
+        # out-of-budget jobs answer 504 — the rest still have time and
+        # fall back to sequential execution under their own deadlines
+        deadline = min(by_idx[i].remaining_s() for i, *_ in entries)
+        if deadline <= 0:
+            # ingest already consumed the tightest budget: a <= 0
+            # deadline would run the group UNSUPERVISED (watchdog treats
+            # it as disabled) — route everyone through the sequential
+            # path instead, where expiry is an immediate 504 and live
+            # jobs keep their own supervised deadlines
+            for i, *_ in entries:
+                job = by_idx[i]
+                try:
+                    self._finish_single(job, copy.deepcopy(self.abpt))
+                finally:
+                    self.admission.mark_done(job)
+            return
+        try:
+            results = call_with_deadline(
+                lambda: flush_lockstep_group(entries, abpt, self._devices,
+                                             gi),
+                deadline_s=deadline, label=f"serve_group:{gi}")
+        except DispatchTimeout:
+            for i, *_ in entries:
+                job = by_idx[i]
+                try:
+                    if job.remaining_s() <= 0:
+                        obs.record_fault("request_timeout",
+                                         detail=job.label,
+                                         action="worker_abandoned")
+                        if job.finish("timeout",
+                                      error="request deadline expired"):
+                            self.account(job, "timeout")
+                    else:
+                        self._finish_single(job, copy.deepcopy(self.abpt))
+                finally:
+                    self.admission.mark_done(job)
+            return
+        share = (time.perf_counter() - t0) / max(1, len(entries))
+        for i, ab, _seqs, _weights in entries:
+            job = by_idx[i]
+            try:
+                if i in results:
+                    buf = io.StringIO()
+                    output(results[i], abpt, buf)
+                    if job.finish("ok", body=buf.getvalue()):
+                        self.account(job, "ok")
+                else:
+                    # device path dropped this set: the sequential path
+                    # is the same fallback the -l batch runner takes
+                    self._finish_single(job, copy.deepcopy(self.abpt))
+            finally:
+                self.admission.mark_done(job, share)
+
+
+def _make_handler(server: AlignServer):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # ------------------------------------------------------ plumbing
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers: Optional[dict] = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client gave up; its job already reached terminal
+
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None) -> None:
+            self._send(code, (json.dumps(obj) + "\n").encode(),
+                       "application/json", headers)
+
+        def log_message(self, *a):  # request spam stays off stderr
+            pass
+
+        # ------------------------------------------------------ GET
+        def do_GET(self):  # noqa: N802 — http.server API
+            path = self.path.rstrip("/")
+            if path == "/healthz":
+                self._json(200, server.health())
+            elif path == "/readyz":
+                if server.draining.is_set():
+                    self._json(503, {"status": "draining"})
+                elif not server.ready.is_set():
+                    self._json(503, {"status": "warming"})
+                else:
+                    self._json(200, {"status": "ready"})
+            elif path == "/metrics":
+                from ..obs import metrics
+                self._send(200, metrics.registry().render().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+
+        # ------------------------------------------------------ POST
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path.rstrip("/") != "/align":
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+                return
+            if server.draining.is_set():
+                # the body was never read: close the connection, or a
+                # keep-alive client's unread bytes would parse as its
+                # next request line
+                self.close_connection = True
+                server.bump("draining", 0.0)
+                self._json(503, {"error": "server is draining"},
+                           {"Retry-After": "30"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                # body length unknowable -> body unread -> must close
+                self.close_connection = True
+                server.bump("poisoned", 0.0)
+                self._json(400, {"error": "malformed Content-Length"})
+                return
+            if n > max_body_bytes():
+                self.close_connection = True  # body unread, same as above
+                server.bump("oversized", 0.0)
+                self._json(413, {"error": f"body {n} B exceeds the "
+                                          f"{max_body_bytes()} B limit"})
+                return
+            raw = self.rfile.read(n) if n else b""
+            t0 = time.perf_counter()
+            try:
+                job = self._parse_job(raw)
+            except Exception as e:  # malformed body: 400, never a crash
+                server.bump("poisoned", time.perf_counter() - t0)
+                obs.record_fault("poisoned_set", detail=str(e)[:300],
+                                 action="rejected_400")
+                self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            admitted, reason, retry_after = server.admission.try_admit(job)
+            if not admitted:
+                status = "draining" if reason == "draining" else "rejected"
+                server.bump(status, job.wall_s())
+                code = 503 if reason == "draining" else 429
+                self._json(code, {"error": f"admission rejected: {reason}"},
+                           {"Retry-After": str(int(max(1, retry_after)))})
+                return
+            # wait for the worker verdict; the slack covers worker pickup
+            # and the watchdog's own bookkeeping — the worker-side
+            # deadline is authoritative
+            if not job.done.wait(job.deadline_s + 10.0):
+                if job.finish("timeout", error="server lost the request"):
+                    server.account(job, "timeout")
+            status = job.status
+            if status == "ok":
+                self._send(200, job.body.encode(), "text/x-fasta",
+                           {"X-Abpoa-Reads": str(job.n_reads)})
+            elif status == "poisoned":
+                self._json(400, {"error": job.error})
+            elif status == "timeout":
+                self._json(504, {"error": job.error or
+                                 "request deadline expired"})
+            else:
+                self._json(500, {"error": job.error or "internal error"})
+
+        def _parse_job(self, raw: bytes) -> Job:
+            from ..io.fastx import read_fastx_text
+            from ..resilience import validate_records
+            from ..resilience.memory import estimate_bytes
+            from ..align.eligibility import fused_eligible
+            from ..compile.ladder import qp_rung
+            records = read_fastx_text(raw.decode("utf-8", errors="strict"))
+            # same validation the -l quarantine boundary applies — a
+            # poisoned set costs a parse, never a worker
+            validate_records(records, server.abpt)
+            caps = request_caps(server.abpt, records)
+            deadline = server.deadline_s
+            hdr = self.headers.get("X-Abpoa-Deadline-S")
+            if hdr:
+                try:
+                    deadline = min(deadline, float(hdr))
+                except ValueError:
+                    pass
+            qmax = max(len(r.seq) for r in records)
+            return Job(records, rung=qp_rung(qmax),
+                       est_bytes=estimate_bytes(caps),
+                       eligible=fused_eligible(server.abpt, len(records)),
+                       deadline_s=deadline)
+
+    return Handler
+
+
+# --------------------------------------------------------------------------- #
+# CLI entry                                                                   #
+# --------------------------------------------------------------------------- #
+
+def _build_parser() -> argparse.ArgumentParser:
+    from .. import constants as C
+    ap = argparse.ArgumentParser(
+        prog="abpoa-tpu serve",
+        description="persistent aligner service: POST FASTA/FASTQ to "
+                    "/align, scrape /metrics, watch /healthz//readyz; "
+                    "admission-bounded (429 + Retry-After past the queue "
+                    "or memory budget), per-request deadlines (504), "
+                    "poisoned-set isolation (400), graceful drain on "
+                    "SIGTERM")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT,
+                    help="listen port; 0 picks an ephemeral port "
+                         "[%(default)s]")
+    ap.add_argument("--workers", type=int,
+                    default=min(4, os.cpu_count() or 1),
+                    help="alignment worker threads [%(default)s]")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="admission queue bound "
+                         "[ABPOA_TPU_SERVE_QUEUE or 64]")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="per-request wall deadline "
+                         "[ABPOA_TPU_SERVE_DEADLINE_S or 30]")
+    ap.add_argument("--warm", choices=["auto", "quick", "full", "off"],
+                    default="auto",
+                    help="AOT-precompile the bucket ladder before "
+                         "admitting [auto: quick on device backends]")
+    ap.add_argument("--metrics", type=str, nargs="?", metavar="FILE",
+                    default=None, const="",
+                    help="also maintain the Prometheus textfile exporter "
+                         "(the `abpoa-tpu top` feed) "
+                         "[FILE defaults to ~/.cache/abpoa_tpu/"
+                         "metrics.prom]")
+    ap.add_argument("--device", type=str, default="auto",
+                    help="DP backend: auto | numpy | native | jax | "
+                         "pallas [%(default)s]")
+    ap.add_argument("--lockstep", type=str, default="auto",
+                    choices=["auto", "on", "off"],
+                    help="coalesce same-rung requests into vmapped "
+                         "lockstep dispatches [auto: accelerator only]")
+    ap.add_argument("-m", "--aln-mode", type=int, default=C.GLOBAL_MODE)
+    ap.add_argument("-M", "--match", type=int, default=C.DEFAULT_MATCH)
+    ap.add_argument("-X", "--mismatch", type=int, default=C.DEFAULT_MISMATCH)
+    ap.add_argument("-O", "--gap-open", type=str, default=None)
+    ap.add_argument("-E", "--gap-ext", type=str, default=None)
+    ap.add_argument("-r", "--result", type=int, default=C.OUT_CONS)
+    ap.add_argument("-a", "--cons-algrm", type=int, default=C.CONS_HB)
+    ap.add_argument("-d", "--maxnum-cons", type=int, default=1)
+    ap.add_argument("-q", "--min-freq", type=float, default=C.MULTIP_MIN_FREQ)
+    return ap
+
+
+def _params_from_args(args) -> Params:
+    # the -O/-E/-r decoding is cli.py's, shared — serve flags can never
+    # silently diverge from the batch CLI's meaning of the same flag
+    from ..cli import apply_gap_args, apply_result_mode
+    abpt = Params()
+    abpt.align_mode = args.aln_mode
+    abpt.match = args.match
+    abpt.mismatch = args.mismatch
+    apply_gap_args(abpt, args.gap_open, args.gap_ext)
+    if not apply_result_mode(abpt, args.result):
+        raise ValueError(f"unknown output result mode: {args.result}")
+    abpt.cons_algrm = args.cons_algrm
+    if not 1 <= args.maxnum_cons <= 10:
+        # same bound the batch CLI enforces for -d
+        raise ValueError("max number of consensus sequences should be 1~10")
+    abpt.max_n_cons = args.maxnum_cons
+    abpt.min_freq = args.min_freq
+    abpt.device = args.device
+    abpt.lockstep = args.lockstep
+    return abpt
+
+
+def serve_main(argv) -> int:
+    """`abpoa-tpu serve` — run the service until SIGTERM/SIGINT, then
+    drain: stop admitting (503), finish in-flight, flush metrics and the
+    report archive, exit 0."""
+    args = _build_parser().parse_args(argv)
+    try:
+        abpt = _params_from_args(args).finalize()
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    metrics_path = None
+    try:
+        server = AlignServer(abpt, host=args.host, port=args.port,
+                             workers=args.workers,
+                             queue_depth=args.queue_depth,
+                             deadline_s=args.deadline_s)
+    except OSError as e:
+        print(f"Error: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 1
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, _frame):
+        print(f"[abpoa-tpu serve] signal {signum}: draining "
+              "(no new admissions; in-flight requests finish)",
+              file=sys.stderr)
+        server.begin_drain()
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        # the line operators (and the smoke harness) wait for: the bind
+        # already happened in the constructor, so the port is
+        # authoritative here (--port 0 picks ephemeral) — printed BEFORE
+        # the AOT warm, which can take minutes on a cold cache; /readyz
+        # answers 503 until warm completes
+        print(f"[abpoa-tpu serve] listening on "
+              f"http://{server.host}:{server.port} "
+              f"(workers={args.workers}, queue="
+              f"{server.admission._max_depth}, "
+              f"deadline={server.deadline_s:.0f}s, device={abpt.device})",
+              file=sys.stderr, flush=True)
+        server.start(warm=args.warm)
+        if args.metrics is not None:
+            metrics_path = args.metrics or obs.metrics.default_textfile_path()
+            os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
+            obs.metrics.start_textfile_exporter(metrics_path)
+        stop_evt.wait()
+        drained = server.drain()
+        server.shutdown_http()
+        if not drained:
+            print("[abpoa-tpu serve] Warning: drain grace expired with "
+                  "work still in flight (answers already sent or timed "
+                  "out)", file=sys.stderr)
+    finally:
+        if metrics_path is not None:
+            obs.metrics.stop_textfile_exporter()
+        # the final process report is one more archive record: the
+        # served window's roll-up next to its per-request records
+        rep = obs.finalize_report()
+        obs.archive.append_report(rep, label="serve", device=abpt.device)
+    served = server.stats()
+    total = sum(served.values())
+    print(f"[abpoa-tpu serve] drained clean: {total} requests "
+          + " ".join(f"{k}={v}" for k, v in sorted(served.items())),
+          file=sys.stderr)
+    return 0

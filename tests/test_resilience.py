@@ -97,6 +97,109 @@ def test_breaker_demotion_ladder():
     assert not br.is_open("jax")
 
 
+def test_breaker_half_open_probe_recloses(monkeypatch):
+    """trip -> cooldown -> single probe -> reclose (ISSUE 12): a long-
+    lived serve process reclaims a demoted backend after a transient
+    fault instead of serving degraded until restart."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_COOLDOWN_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "2")
+    br = rz.breaker()
+    br.record_failure("jax", "oom")
+    br.record_failure("jax", "oom")
+    assert br.is_open("jax")
+    # before the cooldown: no probe permit, callers short-circuit, and
+    # per-read resolution (dispatch._resolve) demotes
+    assert br.acquire("jax") is None
+    assert br.effective("jax") == "native"
+    time.sleep(0.12)
+    # cooldown elapsed: exactly ONE caller gets the probe permit, and
+    # effective() names the backend again so the per-read path can BE
+    # that caller (not just the fused route)
+    assert not br.is_open("jax")
+    assert br.effective("jax") == "jax"
+    assert br.acquire("jax") == "probe"
+    # ...and everyone else stays demoted while it runs
+    assert br.acquire("jax") is None
+    assert br.is_open("jax")
+    # a stale pre-open dispatch reporting success must NOT reclose on
+    # the probe holder's behalf
+    br.record_success("jax", probe=False)
+    assert br.is_open("jax")
+    # the probe holder succeeds -> reclosed, failures zeroed, degraded
+    # block cleared
+    br.record_success("jax", probe=True)
+    assert not br.is_open("jax")
+    assert br.acquire("jax") == "closed"
+    assert obs.report().degraded.get("jax") is None
+    assert obs.report().counters.get("breaker.reclose.jax") == 1
+    # the zeroed failure count means one later blip does not insta-trip
+    br.record_failure("jax", "oom")
+    assert not br.is_open("jax")
+
+
+def test_breaker_half_open_probe_failure_reopens(monkeypatch):
+    from abpoa_tpu import resilience as rz
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_COOLDOWN_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "2")
+    br = rz.breaker()
+    br.record_failure("jax", "oom")
+    br.record_failure("jax", "oom")
+    time.sleep(0.12)
+    assert br.acquire("jax") == "probe"
+    # a stale non-probe failure while open must not touch probe state
+    br.record_failure("jax", "oom", probe=False)
+    assert br.open["jax"]["probing"]
+    # the probe itself fails -> reopened with a fresh cooldown
+    br.record_failure("jax", "hang", probe=True)
+    assert br.is_open("jax")
+    assert br.acquire("jax") is None
+    from abpoa_tpu import obs
+    assert obs.report().counters.get("breaker.probe_fail.jax") == 1
+    # the next cooldown hands out a new probe; success recovers
+    time.sleep(0.12)
+    assert br.acquire("jax") == "probe"
+    br.record_success("jax", probe=True)
+    assert not br.is_open("jax")
+
+
+def test_breaker_probe_through_guarded_dispatch(monkeypatch):
+    """End-to-end: guarded_device_call claims the probe permit, a healthy
+    dispatch recloses the breaker, and the pre-reclose short-circuit
+    behavior is preserved inside the cooldown."""
+    from abpoa_tpu import resilience as rz
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_COOLDOWN_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "1")
+    br = rz.breaker()
+    br.record_failure("jax", "oom")
+    assert br.is_open("jax")
+    with pytest.raises(rz.DispatchFailed) as ei:
+        rz.guarded_device_call("t", "jax", lambda: "never")
+    assert ei.value.kind == "breaker_open"
+    time.sleep(0.12)
+    assert rz.guarded_device_call("t", "jax", lambda: "ok") == "ok"
+    assert not br.is_open("jax")
+
+
+def test_breaker_abort_probe_on_unclassified(monkeypatch):
+    """An unclassified exception during the probe must release the permit
+    (breaker stays open, cooldown restarts) — never wedge 'probing'."""
+    from abpoa_tpu import resilience as rz
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_COOLDOWN_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_BREAKER_THRESHOLD", "1")
+    br = rz.breaker()
+    br.record_failure("jax", "oom")
+    time.sleep(0.12)
+    with pytest.raises(TypeError):
+        rz.guarded_device_call(
+            "t", "jax", lambda: (_ for _ in ()).throw(TypeError("bug")))
+    assert br.is_open("jax")          # still demoted
+    assert not br.open["jax"]["probing"]   # but not wedged probing
+    time.sleep(0.12)
+    assert br.acquire("jax") == "probe"    # next cooldown probes again
+
+
 def test_watchdog_deadline():
     from abpoa_tpu import resilience as rz
     assert rz.watchdog.call_with_deadline(lambda: 41 + 1, 5.0) == 42

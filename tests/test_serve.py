@@ -1,0 +1,432 @@
+"""`abpoa-tpu serve` tests (ISSUE 12): admission control, per-request
+deadlines, poisoned-set isolation, endpoint contracts, graceful drain,
+loadgen, and the `top` serve panel.
+
+In-process servers run on the numpy host backend (no jax import, fast
+startup); the SIGTERM drain test uses a real subprocess because exit
+status and signal handling ARE the contract under test."""
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import DATA_DIR
+
+TEST_FA = os.path.join(DATA_DIR, "test.fa")
+POISON_FQ = b"@truncated\nACGTACGT\n+\nIII\n"   # qual len != seq len
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    rz.inject.reset()
+    rz.breaker().reset()
+    yield
+    rz.inject.reset()
+    rz.breaker().reset()
+    obs.start_run()
+
+
+def _params(device="numpy"):
+    from abpoa_tpu.params import Params
+    abpt = Params()
+    abpt.device = device
+    return abpt
+
+
+def _oracle_bytes(path=TEST_FA):
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.pipeline import Abpoa, msa
+    buf = io.StringIO()
+    msa(Abpoa(), _params().finalize(), read_fastx(path), buf)
+    return buf.getvalue().encode()
+
+
+def _start_server(**kw):
+    from abpoa_tpu.serve import AlignServer
+    srv = AlignServer(_params(), port=0, **kw)
+    srv.start(warm="off")
+    return srv
+
+
+def _post(base, body, headers=None, timeout=30):
+    req = urllib.request.Request(base + "/align", data=body, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get_json(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# --------------------------------------------------------------------- #
+# admission unit tests                                                   #
+# --------------------------------------------------------------------- #
+
+def _job(rung=128, est=1000, eligible=True, deadline=30.0):
+    from abpoa_tpu.serve.admission import Job
+    return Job(records=[], rung=rung, est_bytes=est, eligible=eligible,
+               deadline_s=deadline)
+
+
+def test_admission_depth_bound():
+    from abpoa_tpu.serve.admission import AdmissionController
+    adm = AdmissionController(_params(), max_depth=2, budget_bytes=None)
+    assert adm.try_admit(_job())[0]
+    assert adm.try_admit(_job())[0]
+    ok, reason, retry = adm.try_admit(_job())
+    assert not ok and reason == "queue_full" and retry >= 1.0
+    # draining refuses everything
+    adm.close_intake()
+    assert adm.try_admit(_job())[1] == "draining"
+
+
+def test_admission_memory_bound_never_starves_solo_request():
+    from abpoa_tpu.serve.admission import AdmissionController
+    adm = AdmissionController(_params(), max_depth=10, budget_bytes=1000)
+    # a single over-budget request is ALWAYS admissible on an empty
+    # system (dispatch-time admission chunks/demotes it); the byte gate
+    # bounds concurrency only
+    big = _job(est=5000)
+    assert adm.try_admit(big)[0]
+    ok, reason, _ = adm.try_admit(_job(est=10))
+    assert not ok and reason == "memory"
+    group = adm.next_group()
+    assert group == [big]
+    adm.mark_done(big)
+    # after release the small one fits
+    assert adm.try_admit(_job(est=10))[0]
+
+
+def test_admission_coalesces_same_rung_only():
+    from abpoa_tpu.serve.admission import AdmissionController
+    adm = AdmissionController(_params(), max_depth=10, budget_bytes=None)
+    a, b, c, d = (_job(rung=128), _job(rung=256), _job(rung=128),
+                  _job(rung=128, eligible=False))
+    for j in (a, b, c, d):
+        assert adm.try_admit(j)[0]
+    group = adm.next_group(max_k=4, coalesce=True)
+    # head rung 128 packs the later 128 job, skips the 256 and the
+    # ineligible one; FIFO order preserved within the group
+    assert group == [a, c]
+    assert adm.next_group(max_k=4, coalesce=True) == [b]
+    assert adm.next_group(max_k=4, coalesce=True) == [d]
+    for j in (a, b, c, d):
+        adm.mark_done(j)
+    assert adm.drained()
+
+
+def test_request_caps_prices_with_ladder_rungs():
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.resilience.memory import estimate_bytes
+    from abpoa_tpu.serve.admission import request_caps
+    caps = request_caps(_params().finalize(), read_fastx(TEST_FA))
+    assert caps["Qp"] == 128 and caps["N"] == 1024    # smallest rungs
+    assert estimate_bytes(caps) > 0
+
+
+def test_request_caps_agree_with_fused_planner():
+    """Drift guard: admission pricing and the fused dispatch planner must
+    key through the same rung formulas (compile.ladder is the shared
+    definition site) — a formula change that reaches one but not the
+    other would silently mis-price the serve byte gate."""
+    from abpoa_tpu.align.fused_loop import plan_dispatch_footprint
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.serve.admission import request_caps
+    abpt = _params(device="jax").finalize()
+    for path in (TEST_FA, os.path.join(DATA_DIR, "seq.fa")):
+        records = read_fastx(path)
+        caps = request_caps(abpt, records)
+        plan = plan_dispatch_footprint(abpt, [[r.seq for r in records]])
+        for axis in ("N", "E", "A", "W", "Qp", "reads", "K", "gap_mode",
+                     "m"):
+            assert caps[axis] == plan[axis], (axis, caps, plan)
+
+
+# --------------------------------------------------------------------- #
+# endpoint contracts (in-process server, numpy backend)                  #
+# --------------------------------------------------------------------- #
+
+def test_align_bytes_identical_to_oracle_and_health_endpoints():
+    srv = _start_server(workers=2)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, body, headers = _post(base, open(TEST_FA, "rb").read())
+        assert code == 200
+        assert body == _oracle_bytes()
+        assert headers.get("X-Abpoa-Reads") == "4"
+        code, h = _get_json(base, "/healthz")
+        assert code == 200 and h["status"] == "ok"
+        assert h["served"].get("ok") == 1 and h["degraded"] is None
+        assert _get_json(base, "/readyz")[0] == 200
+        assert _get_json(base, "/nope")[0] == 404
+    finally:
+        assert srv.stop()
+
+
+def test_poisoned_request_is_400_worker_survives():
+    from abpoa_tpu import obs
+    srv = _start_server(workers=1)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, body, _ = _post(base, POISON_FQ)
+        assert code == 400
+        assert b"quality length" in body
+        # empty body is a 400 too, never a crash
+        assert _post(base, b"")[0] == 400
+        assert _post(base, b"\x00\xff garbage \x9c")[0] == 400
+        # the worker is alive and healthy work still completes
+        code, body, _ = _post(base, open(TEST_FA, "rb").read())
+        assert code == 200 and body == _oracle_bytes()
+        # quarantine semantics: fault records, no crash
+        assert obs.report().counters.get("faults.poisoned_set", 0) >= 1
+    finally:
+        srv.stop()
+
+
+def test_queue_overflow_sheds_429_with_retry_after(monkeypatch):
+    monkeypatch.setenv("ABPOA_TPU_SERVE_DELAY_S", "0.4")
+    srv = _start_server(workers=1, queue_depth=1)
+    base = f"http://127.0.0.1:{srv.port}"
+    payload = open(TEST_FA, "rb").read()
+    codes = []
+
+    def post():
+        codes.append(_post(base, payload))
+
+    try:
+        threads = [threading.Thread(target=post) for _ in range(5)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        for t in threads:
+            t.join()
+        got = [c for c, _b, _h in codes]
+        assert got.count(200) >= 1
+        shed = [(c, h) for c, _b, h in codes if c == 429]
+        assert shed, f"no 429s: {got}"
+        assert all(int(h["Retry-After"]) >= 1 for _c, h in shed)
+        # every 200 still byte-identical under pressure
+        assert all(b == _oracle_bytes() for c, b, _h in codes if c == 200)
+    finally:
+        srv.stop()
+
+
+def test_request_deadline_expires_as_504(monkeypatch):
+    from abpoa_tpu import obs
+    monkeypatch.setenv("ABPOA_TPU_SERVE_DELAY_S", "0.5")
+    srv = _start_server(workers=1)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        t0 = time.perf_counter()
+        code, body, _ = _post(base, open(TEST_FA, "rb").read(),
+                              headers={"X-Abpoa-Deadline-S": "0.05"})
+        dt = time.perf_counter() - t0
+        assert code == 504
+        assert dt < 5.0, "504 must come from the deadline, not the delay"
+        assert obs.report().counters.get("faults.request_timeout", 0) >= 1
+        # the worker was abandoned, not wedged: next request succeeds
+        monkeypatch.setenv("ABPOA_TPU_SERVE_DELAY_S", "0")
+        code, body, _ = _post(base, open(TEST_FA, "rb").read())
+        assert code == 200 and body == _oracle_bytes()
+    finally:
+        srv.stop()
+
+
+def test_metrics_endpoint_lints_with_serve_families():
+    from abpoa_tpu.obs import metrics as M
+    srv = _start_server(workers=1)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        _post(base, open(TEST_FA, "rb").read())
+        _post(base, POISON_FQ)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert M.lint_exposition(text) == []
+        samples, types = M.parse_exposition(text)
+        assert M.sample_value(samples, "abpoa_serve_requests_total",
+                              status="ok") >= 1
+        assert M.sample_value(samples, "abpoa_serve_requests_total",
+                              status="poisoned") >= 1
+        assert ("abpoa_serve_queue_depth", frozenset()) in samples
+        assert ("abpoa_serve_inflight", frozenset()) in samples
+        assert types.get("abpoa_serve_request_seconds") == "histogram"
+        # the render-time quantile gauges cover the serve histogram too
+        assert M.sample_value(samples, "abpoa_serve_request_seconds_quantile",
+                              quantile="0.99") is not None
+    finally:
+        srv.stop()
+
+
+def test_drain_in_process_rejects_new_finishes_inflight(monkeypatch):
+    monkeypatch.setenv("ABPOA_TPU_SERVE_DELAY_S", "0.6")
+    srv = _start_server(workers=1)
+    base = f"http://127.0.0.1:{srv.port}"
+    res = {}
+
+    def post(key):
+        res[key] = _post(base, open(TEST_FA, "rb").read())
+
+    t = threading.Thread(target=post, args=("inflight",))
+    t.start()
+    time.sleep(0.2)        # request now executing (0.6 s service time)
+    srv.begin_drain()
+    code, h = _get_json(base, "/readyz")
+    assert code == 503 and h["status"] == "draining"
+    assert _get_json(base, "/healthz")[1]["status"] == "draining"
+    post("after")
+    t.join()
+    assert res["after"][0] == 503
+    assert res["inflight"][0] == 200
+    assert res["inflight"][1] == _oracle_bytes()
+    assert srv.drain(timeout=10)
+    srv.shutdown_http()
+
+
+# --------------------------------------------------------------------- #
+# graceful drain, full-process contract (SIGTERM -> rc 0)                #
+# --------------------------------------------------------------------- #
+
+def test_sigterm_drains_flushes_and_exits_zero(tmp_path):
+    """ISSUE 12 satellite: SIGTERM mid-request -> the in-flight request
+    completes (byte-identical), subsequent requests get 503, the process
+    exits 0 with metrics flushed and the final report archived."""
+    metrics_path = str(tmp_path / "metrics.prom")
+    archive_dir = str(tmp_path / "reports")
+    env = dict(os.environ,
+               ABPOA_TPU_SKIP_PROBE="1",
+               ABPOA_TPU_ARCHIVE="1",
+               ABPOA_TPU_ARCHIVE_DIR=archive_dir,
+               ABPOA_TPU_SERVE_DELAY_S="1.2")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port", "0",
+         "--device", "numpy", "--workers", "1", "--metrics", metrics_path],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            if "listening on http://" in line:
+                port = int(line.split("listening on http://")[1]
+                           .split()[0].rsplit(":", 1)[1])
+                break
+        assert port, "server never printed its listening line"
+        base = f"http://127.0.0.1:{port}"
+        # readiness (numpy backend: no warm, near-instant)
+        for _ in range(100):
+            try:
+                if urllib.request.urlopen(base + "/readyz",
+                                          timeout=2).status == 200:
+                    break
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.1)
+        res = {}
+
+        def post(key):
+            res[key] = _post(base, open(TEST_FA, "rb").read(), timeout=60)
+
+        t = threading.Thread(target=post, args=("inflight",))
+        t.start()
+        time.sleep(0.4)            # in flight (1.2 s service time)
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+        post("after")              # during the drain window
+        t.join(30)
+        rc = proc.wait(timeout=60)
+        stderr_rest = proc.stderr.read()
+        assert rc == 0, f"drain exited rc={rc}:\n{stderr_rest[-2000:]}"
+        assert res["inflight"][0] == 200
+        assert res["inflight"][1] == _oracle_bytes()
+        assert res["after"][0] == 503
+        assert "drained clean" in stderr_rest
+        assert "Traceback" not in stderr_rest
+        # metrics flushed on the way out, lint-clean
+        from abpoa_tpu.obs import metrics as M
+        with open(metrics_path) as fp:
+            final = fp.read()
+        assert M.lint_exposition(final) == []
+        samples, _t = M.parse_exposition(final)
+        assert M.sample_value(samples, "abpoa_serve_requests_total",
+                              status="ok") == 1
+        # archive: one record per terminal request + the final process
+        # report roll-up
+        with open(os.path.join(archive_dir, "reports.jsonl")) as fp:
+            recs = [json.loads(ln) for ln in fp.read().splitlines()]
+        kinds = [r.get("kind") for r in recs]
+        assert kinds.count("serve_request") == 1
+        assert any(r.get("label") == "serve" for r in recs), \
+            "final process report never archived"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+# --------------------------------------------------------------------- #
+# loadgen + top panel                                                    #
+# --------------------------------------------------------------------- #
+
+def test_loadgen_open_loop_summary():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from loadgen import LoadGen
+    srv = _start_server(workers=2, queue_depth=32)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        payload = open(TEST_FA, "rb").read()
+        gen = LoadGen(base, [payload, POISON_FQ], rate=40.0, n=20,
+                      timeout_s=30)
+        s = gen.run()
+        assert s["sent"] == 20 and s["errors"] == 0
+        assert sum(s["status"].values()) == 20
+        assert s["status"].get("400") == 10      # alternating payloads
+        assert s["ok"] == 10
+        assert all(b == _oracle_bytes() for b in gen.bodies_ok)
+        assert s["latency_ms"]["p99"] is not None
+        assert 0 < s["rate_achieved"] <= 120.0
+    finally:
+        srv.stop()
+
+
+def test_top_renders_serve_panel():
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.obs.top import render_frame
+    expo = "\n".join([
+        "# TYPE abpoa_serve_requests_total counter",
+        'abpoa_serve_requests_total{status="ok"} 182',
+        'abpoa_serve_requests_total{status="rejected"} 24',
+        "# TYPE abpoa_serve_queue_depth gauge",
+        "abpoa_serve_queue_depth 3",
+        "# TYPE abpoa_serve_inflight gauge",
+        "abpoa_serve_inflight 2",
+        "# TYPE abpoa_serve_request_seconds_quantile gauge",
+        'abpoa_serve_request_seconds_quantile{quantile="0.5"} 0.038',
+        'abpoa_serve_request_seconds_quantile{quantile="0.95"} 0.081',
+        'abpoa_serve_request_seconds_quantile{quantile="0.99"} 0.13',
+        "# TYPE abpoa_runs_total counter",
+        "abpoa_runs_total 1",
+    ]) + "\n"
+    samples, types = M.parse_exposition(expo)
+    frame = render_frame(samples, types, "x.prom", 0.5)
+    assert "serve" in frame
+    assert "queue 3" in frame and "inflight 2" in frame
+    assert "ok=182" in frame and "rejected=24" in frame
+    assert "p99 130.00" in frame

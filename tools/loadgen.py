@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Open-loop load generator for `abpoa-tpu serve`.
+
+Open-loop means arrivals follow a CLOCK, not the server: request i is
+launched at t0 + i/rate regardless of whether earlier requests have
+answered. That is the only honest way to measure an overloaded service —
+a closed loop (wait-then-send) self-throttles to whatever the server can
+do and hides the queueing collapse entirely; under an open-loop arrival
+rate past capacity, latency and shed rate (429s) show the real knee.
+(The coordinated-omission argument; same methodology the chaos soak
+uses to claim "survives 2x overload".)
+
+Latency lands in the same `LogSketch` histogram the serve metrics use
+(abpoa_tpu/obs/metrics.py), so loadgen percentiles and server-side
+percentiles are directly comparable. Output is one JSON summary:
+
+    {"sent": 240, "rate_target": 40.0, "rate_achieved": 39.7,
+     "status": {"200": 180, "429": 57, "504": 3},
+     "latency_ms": {"p50": 38.2, "p95": 81.0, "p99": 130.5},
+     "errors": 0, ...}
+
+Usage:
+    python tools/loadgen.py --url http://127.0.0.1:8673 \
+        --payload tests/data/test.fa --rate 40 --n 240 [--out gen.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from abpoa_tpu.obs.metrics import LogSketch  # noqa: E402
+
+
+class LoadGen:
+    """One open-loop run. Thread-per-in-flight-request (stdlib-only);
+    `max_inflight` bounds client-side thread growth when the server falls
+    behind — launches past the bound are counted `client_dropped`, which
+    is itself a signal the target rate exceeded client capacity."""
+
+    def __init__(self, url: str, payloads: List[bytes], rate: float,
+                 n: int, timeout_s: float = 60.0, max_inflight: int = 256,
+                 deadline_hdr: Optional[float] = None) -> None:
+        self.url = url.rstrip("/")
+        self.payloads = payloads
+        self.rate = rate
+        self.n = n
+        self.timeout_s = timeout_s
+        self.max_inflight = max_inflight
+        self.deadline_hdr = deadline_hdr
+        self.sketch = LogSketch()
+        self.status: dict = {}
+        self.errors = 0
+        self.client_dropped = 0
+        self.bodies_ok: List[bytes] = []
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def _one(self, i: int) -> None:
+        payload = self.payloads[i % len(self.payloads)]
+        headers = {"Content-Type": "text/x-fasta"}
+        if self.deadline_hdr is not None:
+            headers["X-Abpoa-Deadline-S"] = str(self.deadline_hdr)
+        req = urllib.request.Request(self.url + "/align", data=payload,
+                                     method="POST", headers=headers)
+        t0 = time.perf_counter()
+        code, body = 0, b""
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                code, body = r.status, r.read()
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except (urllib.error.URLError, OSError, TimeoutError):
+            code = 0  # transport error / client timeout
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.sketch.observe(dt)
+            self.status[str(code)] = self.status.get(str(code), 0) + 1
+            if code == 0:
+                self.errors += 1
+            elif code == 200:
+                self.bodies_ok.append(body)
+            self._inflight -= 1
+
+    def run(self) -> dict:
+        t0 = time.perf_counter()
+        threads = []
+        for i in range(self.n):
+            target = t0 + i / self.rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            with self._lock:
+                if self._inflight >= self.max_inflight:
+                    self.client_dropped += 1
+                    continue
+                self._inflight += 1
+            t = threading.Thread(target=self._one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self.timeout_s + 5)
+        wall = time.perf_counter() - t0
+        return self.summary(wall)
+
+    def summary(self, wall_s: float) -> dict:
+        sk = self.sketch
+
+        def ms(q):
+            v = sk.quantile(q)
+            return round(1e3 * v, 2) if v is not None else None
+
+        launched = self.n - self.client_dropped
+        return {
+            "url": self.url,
+            "sent": launched,
+            "client_dropped": self.client_dropped,
+            "rate_target": self.rate,
+            "rate_achieved": round(launched / wall_s, 2) if wall_s else None,
+            "wall_s": round(wall_s, 2),
+            "status": dict(sorted(self.status.items())),
+            "ok": self.status.get("200", 0),
+            "shed": self.status.get("429", 0),
+            "errors": self.errors,
+            "latency_ms": {"p50": ms(0.50), "p95": ms(0.95),
+                           "p99": ms(0.99),
+                           "max": (round(1e3 * sk.max, 2)
+                                   if sk.count else None)},
+        }
+
+
+def run_sweep(url: str, payloads: List[bytes], rates: List[float],
+              n_per_rate: int, timeout_s: float = 60.0) -> List[dict]:
+    """The overload-rejection curve: one open-loop run per arrival rate,
+    ascending — PERF.md's served-throughput figure."""
+    out = []
+    for rate in rates:
+        out.append(LoadGen(url, payloads, rate, n_per_rate,
+                           timeout_s=timeout_s).run())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="server base URL (http://host:port)")
+    ap.add_argument("--payload", action="append", required=True,
+                    metavar="FILE",
+                    help="FASTA/FASTQ request body (repeatable; requests "
+                         "round-robin over them)")
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="open-loop arrival rate, requests/s [%(default)s]")
+    ap.add_argument("--n", type=int, default=100,
+                    help="total requests [%(default)s]")
+    ap.add_argument("--timeout-s", type=float, default=60.0,
+                    help="client-side response timeout [%(default)s]")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="send X-Abpoa-Deadline-S on every request")
+    ap.add_argument("--max-inflight", type=int, default=256,
+                    help="client concurrency bound [%(default)s]")
+    ap.add_argument("--sweep", type=str, default=None, metavar="R1,R2,...",
+                    help="run the overload curve: one pass per rate, "
+                         "--n requests each; output is a JSON list")
+    ap.add_argument("--out", type=str, default=None, metavar="FILE",
+                    help="write the JSON summary to FILE (stdout always "
+                         "gets it too)")
+    args = ap.parse_args(argv)
+    payloads = []
+    for p in args.payload:
+        with open(p, "rb") as fp:
+            payloads.append(fp.read())
+    if args.sweep:
+        rates = [float(r) for r in args.sweep.split(",")]
+        result = run_sweep(args.url, payloads, rates, args.n,
+                           timeout_s=args.timeout_s)
+        worst = max((r["errors"] for r in result), default=0)
+    else:
+        result = LoadGen(args.url, payloads, args.rate, args.n,
+                         timeout_s=args.timeout_s,
+                         max_inflight=args.max_inflight,
+                         deadline_hdr=args.deadline_s).run()
+        worst = result["errors"]
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(text + "\n")
+    # transport errors mean the server dropped connections — the one
+    # thing an admission-controlled service must never do
+    return 1 if worst else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Serve chaos soak: the measured form of ISSUE 12's acceptance criteria.
+
+Starts `abpoa-tpu serve` (device jax pinned to CPU — no accelerator
+needed) with EVERY fault injector armed and a 1 s breaker cooldown, then
+drives it with `tools/loadgen.py` at ~2x the calibrated sustainable
+throughput, with poisoned payloads and tiny-deadline probes mixed in.
+The server must:
+
+- never crash or OOM: rc=0 at SIGTERM, zero transport errors client-side,
+  no Traceback in its stderr;
+- shed overload as 429 + Retry-After, never by queueing without bound;
+- answer poisoned sets with 400 and deadline expiries with 504, each with
+  a fault record — while the worker pool survives;
+- keep every 200 byte-identical to the numpy oracle, through compile
+  failures, injected OOMs, hangs and garbage outputs (the degradation
+  ladder + output guards doing their jobs);
+- trip the circuit breaker on the injected fault burst AND reclose it
+  through the half-open cooldown probe once the injectors exhaust
+  (abpoa_breaker_opens_total >= 1 and abpoa_breaker_recloses_total >= 1);
+- leave a lint-clean Prometheus exposition and an archive window on which
+  `abpoa-tpu slo` passes;
+- drain clean on SIGTERM: in-flight finished, metrics flushed, exit 0.
+
+    python tools/serve_smoke.py [--keep] [--requests N] [--no-inject]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+DATA = os.path.join(REPO, "tests", "data")
+sys.path.insert(0, REPO)
+sys.path.insert(0, TOOLS)
+
+POISON_BODY = b"@truncated\nACGTACGT\n+\nIII\n"   # qual len != seq len -> 400
+
+
+def oracle_body(payload_path: str) -> bytes:
+    """The numpy-oracle response bytes for one payload — computed in THIS
+    process on the reference host path; every healthy serve response must
+    match one of these byte for byte."""
+    import io
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa
+    abpt = Params()
+    abpt.device = "numpy"
+    abpt.finalize()
+    buf = io.StringIO()
+    msa(Abpoa(), abpt, read_fastx(payload_path), buf)
+    return buf.getvalue().encode()
+
+
+def wait_ready(base: str, proc, timeout_s: float = 600.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise RuntimeError(f"server exited rc={proc.returncode} "
+                               "before becoming ready")
+        try:
+            with urllib.request.urlopen(base + "/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.25)
+    raise RuntimeError("server never became ready")
+
+
+def read_port(proc, timeout_s: float = 120.0) -> int:
+    """Parse the bound port from the 'listening on' stderr line."""
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(f"server exited rc={proc.returncode} "
+                                   "during startup")
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(f"[server] {line}")
+        if "listening on http://" in line:
+            return int(line.split("listening on http://")[1]
+                       .split()[0].rsplit(":", 1)[1])
+    raise RuntimeError("never saw the listening line")
+
+
+def _drain_stderr(proc, sink: list) -> None:
+    for line in proc.stderr:
+        sink.append(line)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=240,
+                    help="soak request count (>= 200 for the CI claim) "
+                         "[%(default)s]")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir for inspection")
+    ap.add_argument("--no-inject", action="store_true",
+                    help="skip the fault injectors (pure overload soak)")
+    args = ap.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="abpoa_serve_smoke_")
+    payload = os.path.join(DATA, "test.fa")
+    payload2 = os.path.join(DATA, "seq.fa")
+    oracles = {oracle_body(payload), oracle_body(payload2)}
+    metrics_path = os.path.join(tmp, "metrics.prom")
+    archive_dir = os.path.join(tmp, "reports")
+    failures: list = []
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ABPOA_TPU_SKIP_PROBE="1",
+        ABPOA_TPU_BREAKER_THRESHOLD="2",
+        # 0.5 s cooldown: the injected fault burst trips the breaker, then
+        # the half-open probes burn the remaining injector shots and
+        # reclose it DURING the soak — the PR-12 recovery story, measured
+        ABPOA_TPU_BREAKER_COOLDOWN_S="0.5",
+        ABPOA_TPU_INJECT_HANG_S="2.0",
+        ABPOA_TPU_ARCHIVE="1",
+        ABPOA_TPU_ARCHIVE_DIR=archive_dir,
+        ABPOA_TPU_SERVE_QUEUE="8",
+        # a 50 ms service-time floor makes "sustainable throughput" a
+        # machine-independent ~40/s (2 workers), so 2x overload is a
+        # deliverable client rate instead of a same-host TCP stress test
+        ABPOA_TPU_SERVE_DELAY_S="0.05",
+    )
+    if not args.no_inject:
+        env["ABPOA_TPU_INJECT"] = \
+            "compile_fail:2,oom:2,hang:1,garbage:1,poison_set:2"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port", "0",
+         "--device", "jax", "--workers", "2", "--warm", "quick",
+         "--metrics", metrics_path],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        port = read_port(proc)
+        base = f"http://127.0.0.1:{port}"
+        stderr_tail: list = []
+        import threading
+        threading.Thread(target=_drain_stderr, args=(proc, stderr_tail),
+                         daemon=True).start()
+        wait_ready(base, proc)
+
+        from loadgen import LoadGen
+        with open(payload, "rb") as fp:
+            body = fp.read()
+        with open(payload2, "rb") as fp:
+            body2 = fp.read()
+
+        # ---- calibrate sustainable throughput on the healthy server ----
+        cal = LoadGen(base, [body], rate=5.0, n=12, timeout_s=120).run()
+        p50_s = (cal["latency_ms"]["p50"] or 50.0) / 1e3
+        sustainable = 2 / max(1e-3, p50_s)   # 2 workers
+        rate = min(max(4.0, 2.0 * sustainable), 150.0)
+        print(f"[serve-smoke] calibrated p50={p50_s * 1e3:.1f}ms -> "
+              f"sustainable ~{sustainable:.0f}/s, soaking at {rate:.0f}/s "
+              f"x {args.requests} requests", flush=True)
+
+        # ---- the soak: 2x overload, poison mixed in ----
+        # every 40th payload is malformed -> 400 (quarantine isolation)
+        payloads = ([body] * 26 + [POISON_BODY] + [body2] * 13)
+        gen_soak = LoadGen(base, payloads, rate=rate, n=args.requests,
+                           timeout_s=120)
+        soak = gen_soak.run()
+        print("[serve-smoke] soak:", json.dumps(soak), flush=True)
+
+        # ---- deadline probes: a too-tight per-request deadline is a 504,
+        # never a wedged worker ----
+        probes = LoadGen(base, [body], rate=5.0, n=3, timeout_s=60,
+                         deadline_hdr=0.001).run()
+        print("[serve-smoke] deadline probes:", json.dumps(probes),
+              flush=True)
+
+        # ---- settle, then read the server's own story ----
+        # long enough for the half-open cooldown to walk through every
+        # remaining injector shot (each failed probe restarts the 0.5 s
+        # cooldown; the hang probe alone costs 2 s) and reclose
+        gen_settle = LoadGen(base, [body], rate=5.0, n=40, timeout_s=120)
+        settle = gen_settle.run()
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            expo = r.read().decode()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        print("[serve-smoke] health:", json.dumps(health), flush=True)
+
+        # ---- assertions ----
+        if soak["errors"] or probes["errors"] or settle["errors"]:
+            failures.append(
+                f"transport errors: soak={soak['errors']} "
+                f"probes={probes['errors']} settle={settle['errors']} "
+                "(an admission-controlled server never drops connections)")
+        if args.requests >= 100 and not soak["shed"]:
+            failures.append("no 429s at 2x sustainable rate: admission "
+                            "control never engaged")
+        if not soak["status"].get("400"):
+            failures.append("no 400s: poisoned payloads were not isolated")
+        if soak["status"].get("500"):
+            failures.append(f"{soak['status']['500']} 500s: a worker died "
+                            "on a fault shape it should absorb")
+        if probes["status"].get("504", 0) < 1:
+            failures.append(f"deadline probes answered "
+                            f"{probes['status']}, expected 504s")
+        if settle["ok"] != 40:
+            failures.append(f"settle window not fully healthy: "
+                            f"{settle['status']}")
+        if health["status"] == "degraded":
+            failures.append("still degraded after the settle window: "
+                            f"{health['degraded']} (half-open recovery "
+                            "never reclaimed the backend)")
+
+        # byte-identical healthy responses, through every injector: every
+        # 200 body from the overload soak AND the settle window must be
+        # one of the oracle outputs
+        for name, gen in (("soak", gen_soak), ("settle", gen_settle)):
+            bad = sum(1 for b in gen.bodies_ok if b not in oracles)
+            if bad:
+                failures.append(
+                    f"{bad}/{len(gen.bodies_ok)} healthy {name} responses "
+                    "NOT byte-identical to the numpy oracle")
+
+        from abpoa_tpu.obs import metrics as M
+        lint = M.lint_exposition(expo)
+        if lint:
+            failures.append(f"exposition lint: {lint[:3]}")
+        samples, _types = M.parse_exposition(expo)
+
+        def total(fam):
+            return sum(v for (n, _l), v in samples.items() if n == fam)
+
+        if not M.sample_value(samples, "abpoa_serve_requests_total",
+                              status="ok"):
+            failures.append("abpoa_serve_requests_total{status=ok} missing")
+        if not args.no_inject:
+            if total("abpoa_breaker_opens_total") < 1:
+                failures.append("breaker never opened under the injected "
+                                "fault burst")
+            if total("abpoa_breaker_recloses_total") < 1:
+                failures.append("breaker never reclosed: the half-open "
+                                "cooldown probe did not recover the "
+                                "backend")
+            if total("abpoa_injected_faults_total") < 5:
+                failures.append("injectors fired "
+                                f"{total('abpoa_injected_faults_total')} "
+                                "times, expected every armed shot")
+
+        # ---- graceful drain ----
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+        if rc != 0:
+            failures.append(f"SIGTERM drain exited rc={rc}, expected 0")
+        stderr_text = "".join(stderr_tail)
+        if "Traceback" in stderr_text:
+            failures.append("server stderr carries a Traceback:\n"
+                            + stderr_text[-2000:])
+        if "drained clean" not in stderr_text:
+            failures.append("no 'drained clean' summary in server stderr")
+        if not os.path.exists(metrics_path):
+            failures.append("metrics textfile never flushed")
+        else:
+            with open(metrics_path) as fp:
+                final = fp.read()
+            lint = M.lint_exposition(final)
+            if lint:
+                failures.append(f"final exposition lint: {lint[:3]}")
+
+        # ---- the archive answers `abpoa-tpu slo` ----
+        slo = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "slo"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        sys.stdout.write(slo.stdout)
+        if slo.returncode != 0:
+            failures.append(f"`abpoa-tpu slo` rc={slo.returncode} on the "
+                            f"served archive:\n{slo.stdout}\n{slo.stderr}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if args.keep:
+            print(f"[serve-smoke] work dir kept: {tmp}")
+
+    if failures:
+        for f in failures:
+            print(f"[serve-smoke] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[serve-smoke] PASS: {args.requests} soak requests at 2x "
+          "overload with every injector armed — shed as 429s, poison as "
+          "400s, deadlines as 504s, healthy bytes oracle-identical, "
+          "breaker tripped AND reclosed, drain rc=0, slo ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
