@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto = only on a real accelerator mesh (serial "
                         "K=1 is faster on CPU, see ROUND8_NOTES.md); "
                         "on/off force it [%(default)s]")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="supervised worker PROCESSES for -l multi-set "
+                        "runs (crash containment, hard-kill deadlines, "
+                        "poison-job quarantine — parallel/pool.py): "
+                        "0 = auto (one per core on multicore CPU hosts), "
+                        "1 = in-process serial "
+                        "[ABPOA_TPU_WORKERS or %(default)s]")
     p.add_argument("--report", type=str, default=None, metavar="FILE",
                    help="write a structured JSON run report (versioned "
                         "schema: phase wall-times, dispatch/fallback/"
@@ -161,6 +168,9 @@ def args_to_params(args: argparse.Namespace) -> Params:
     abpt.verbose = args.verbose
     abpt.device = args.device
     abpt.lockstep = args.lockstep
+    if args.workers < 0:
+        raise SystemExit("Error: --workers must be >= 0 (0 = auto).")
+    abpt.workers = args.workers
     return abpt
 
 

@@ -428,6 +428,29 @@ _EXACT_FAMILIES = {
     "dp.cells": ("abpoa_dp_cells_total", "DP cells computed"),
     "dp.cell_ops": ("abpoa_dp_cell_ops_total",
                     "Estimated integer ops over DP cells (MFU numerator)"),
+    # process-pool supervisor (parallel/pool.py)
+    "pool.restarts": ("abpoa_pool_restarts_total",
+                      "Pool worker processes respawned after a death or "
+                      "hard kill"),
+    "pool.kills": ("abpoa_pool_kills_total",
+                   "Supervisor-initiated hard SIGKILLs (job deadline, "
+                   "RSS budget, stalled heartbeat)"),
+    "pool.requeues": ("abpoa_pool_requeues_total",
+                      "Jobs requeued onto a fresh worker after their "
+                      "worker died (exactly once per job)"),
+    "pool.poison_jobs": ("abpoa_pool_poison_jobs_total",
+                         "Jobs quarantined after killing their worker "
+                         "twice"),
+    "pool.worker_crashes": ("abpoa_pool_worker_crashes_total",
+                            "Worker processes that died on their own "
+                            "(signal or unexpected exit)"),
+    "pool.worker_xla_compiles": ("abpoa_pool_worker_xla_compiles_total",
+                                 "True XLA compiles inside pool workers "
+                                 "(persistent-cache misses — the "
+                                 "recompile-burst signal)"),
+    "pool.worker_cache_loads": ("abpoa_pool_worker_cache_loads_total",
+                                "Pool worker compile-cache loads served "
+                                "by the persistent XLA cache"),
 }
 
 _BREAKER_PREFIXES = {
@@ -474,23 +497,47 @@ def publish_phase(name: str, wall_s: float) -> None:
             "Wall seconds by pipeline phase").inc(wall_s, phase=name)
 
 
+# one definition site for the per-read families: publish_read and
+# publish_read_aggregate must create them with identical name+help
+# (first creation wins, so a drift would make the exposition text depend
+# on which publisher ran first)
+_READS_FAMILY = ("abpoa_reads_total",
+                 "Reads aligned, by the backend that ran them")
+_READ_FALLBACKS_FAMILY = ("abpoa_read_fallbacks_total",
+                          "Reads that ran on a fallback path, by reason")
+_READ_WALL_FAMILY = ("abpoa_read_wall_seconds",
+                     "Per-read wall seconds (log-bucket sketch, "
+                     f"~{int(LogSketch.RELATIVE_ERROR * 100)}% quantile "
+                     "tolerance)")
+
+
 def publish_read(wall_s: float, backend: str,
                  fallback: Optional[str]) -> None:
     if not _ENABLED:
         return
-    _REGISTRY.counter("abpoa_reads_total",
-                      "Reads aligned, by the backend that ran them").inc(
-        1, backend=backend)
+    _REGISTRY.counter(*_READS_FAMILY).inc(1, backend=backend)
     if fallback:
-        _REGISTRY.counter(
-            "abpoa_read_fallbacks_total",
-            "Reads that ran on a fallback path, by reason").inc(
-            1, reason=fallback)
-    _REGISTRY.histogram(
-        "abpoa_read_wall_seconds",
-        "Per-read wall seconds (log-bucket sketch, "
-        f"~{int(LogSketch.RELATIVE_ERROR * 100)}% quantile tolerance)"
-    ).observe(wall_s)
+        _REGISTRY.counter(*_READ_FALLBACKS_FAMILY).inc(1, reason=fallback)
+    _REGISTRY.histogram(*_READ_WALL_FAMILY).observe(wall_s)
+
+
+def publish_read_aggregate(backends: Dict[str, int],
+                           fallbacks: Dict[str, int],
+                           sketch: LogSketch) -> None:
+    """Bulk form of publish_read for a pool worker's per-job delta:
+    backend/fallback count increments plus a sketch bucket merge, so the
+    exposition matches what per-read publishes would have produced —
+    including reads past the worker's raw-record cap."""
+    if not _ENABLED:
+        return
+    for b, n in backends.items():
+        if n > 0:
+            _REGISTRY.counter(*_READS_FAMILY).inc(n, backend=b)
+    for r, n in fallbacks.items():
+        if n > 0:
+            _REGISTRY.counter(*_READ_FALLBACKS_FAMILY).inc(n, reason=r)
+    if sketch.count:
+        _REGISTRY.histogram(*_READ_WALL_FAMILY).sketch.merge(sketch)
 
 
 def publish_run_start() -> None:
@@ -574,6 +621,31 @@ def publish_serve_state(queue_depth: int, inflight: int) -> None:
     _REGISTRY.gauge("abpoa_serve_inflight",
                     "Requests currently executing in serve workers").set(
         inflight)
+
+
+# ------------------------------------------------------------- pool hooks
+
+def publish_pool_workers(up: int) -> None:
+    """Live (ready) pool worker processes — the supervisor republishes on
+    every spawn, death and hard kill."""
+    if _ENABLED:
+        _REGISTRY.gauge(
+            "abpoa_pool_workers",
+            "Live process-pool worker processes").set(up)
+
+
+def materialize_pool_families() -> None:
+    """Create the pool metric families at pool start so a run that never
+    kills or restarts a worker still exports them at 0 — the chaos/CI
+    assertions (and any alerting rule) must be able to read 'zero kills'
+    rather than 'family absent'."""
+    if not _ENABLED:
+        return
+    publish_pool_workers(0)
+    for key in ("pool.restarts", "pool.kills", "pool.requeues",
+                "pool.poison_jobs", "pool.worker_crashes",
+                "pool.worker_xla_compiles", "pool.worker_cache_loads"):
+        _REGISTRY.counter(*_EXACT_FAMILIES[key]).inc(0)
 
 
 def clear_batch_progress() -> None:

@@ -121,6 +121,39 @@ class RunReport:
             _trace.add_span(name, "phase", t0, dt)
             _metrics.publish_phase(name, dt)
 
+    def merge_phase(self, name: str, wall_s: float, calls: int = 1) -> None:
+        """Fold a phase-wall delta measured in ANOTHER process (a pool
+        worker's job extract) into this report + the fleet registry —
+        same accumulation the phase() context performs, without a timer
+        (the wall was measured where the work ran)."""
+        if not self.enabled:
+            return
+        with _metrics._MUT:
+            rec = self.phases.get(name)
+            if rec is None:
+                self.phases[name] = [wall_s, calls]
+            else:
+                rec[0] += wall_s
+                rec[1] += calls
+        _metrics.publish_phase(name, wall_s)
+
+    def merge_value(self, name: str, count: int, total: float,
+                    vmin: float, vmax: float) -> None:
+        """Fold an observe() summary delta from another process."""
+        if not self.enabled:
+            return
+        with _metrics._MUT:
+            rec = self.values.get(name)
+            if rec is None:
+                self.values[name] = [count, total, vmin, vmax]
+            else:
+                rec[0] += count
+                rec[1] += total
+                if vmin < rec[2]:
+                    rec[2] = vmin
+                if vmax > rec[3]:
+                    rec[3] = vmax
+
     def count(self, name: str, n: int = 1) -> None:
         if self.enabled:
             with _metrics._MUT:

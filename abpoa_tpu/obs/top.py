@@ -108,6 +108,27 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
                              1e3 * sq.get("0.5", 0), 1e3 * sq.get("0.95", 0),
                              1e3 * sq.get("0.99", 0)))
 
+    # process-pool panel (present only when a supervised worker pool ran:
+    # -l --workers N or serve --pool-workers N)
+    pool_up = M.sample_value(samples, "abpoa_pool_workers")
+    if pool_up is not None:
+        parts = [f"workers {pool_up:.0f}"]
+        for fam, lbl in (("abpoa_pool_restarts_total", "restarts"),
+                         ("abpoa_pool_kills_total", "kills"),
+                         ("abpoa_pool_requeues_total", "requeues"),
+                         ("abpoa_pool_poison_jobs_total", "poison")):
+            v = _total(samples, fam)
+            if v:
+                parts.append(f"{lbl} {v:.0f}")
+        lines.append("pool     " + "  ".join(parts))
+
+    # abandoned watchdog threads leak IN-PROCESS dispatches only (inside
+    # pool workers the supervisor's SIGKILL replaces abandonment), so the
+    # readout must not hide behind the pool panel
+    abandoned = M.sample_value(samples, "abpoa_watchdog_abandoned_threads")
+    if abandoned:
+        lines.append(f"watchdog abandoned-threads {abandoned:.0f}")
+
     # phase split
     phases = _labeled(samples, "abpoa_phase_wall_seconds_total", "phase")
     tot = sum(phases.values())

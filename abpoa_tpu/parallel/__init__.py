@@ -1,2 +1,4 @@
+from .pool import (PoolJob, PoolWorkerError, WorkerPool, resolve_workers,
+                   run_pool_batch)
 from .runner import (flush_lockstep_group, lockstep_enabled,
                      lockstep_group_size, run_batch, shard_dp_batch)

@@ -1,0 +1,1347 @@
+"""Supervised multi-process worker pool: crash containment at process
+granularity.
+
+Everything the resilience layer built so far — breaker, watchdog, guards,
+`serve` — lives in ONE process, so a native SIGSEGV, a wedged XLA tunnel
+or an OOM still takes the whole service down, and `watchdog.py` can only
+*abandon* a hung thread (an unbounded leak it already counts). SeGraM
+(arXiv:2205.05883) and AnySeq/GPU (arXiv:2205.07610) get both their
+throughput and their fault story from independent execution units; this
+module gives abpoa-tpu the same property on any multicore host: alignment
+jobs (one read set, or one serve request) execute in spawned worker
+PROCESSES under a supervisor that can always reclaim them.
+
+Supervision contract (the tentpole of ISSUE 13):
+
+- **heartbeats**: a worker thread beats every ``ABPOA_TPU_POOL_HEARTBEAT_S``
+  (default 1 s) while a job executes, carrying the worker's resident-set
+  size; the supervisor reads them without blocking the result path.
+- **hard SIGKILL on deadline expiry** (``ABPOA_TPU_POOL_DEADLINE_S``,
+  default 900 s like the dispatch watchdog; serve jobs carry their own
+  request budget): past the deadline the whole worker process is killed —
+  thread, stack, device handle reclaimed in one stroke. This REPLACES
+  thread abandonment for pool-routed work: `watchdog.supervision_needed`
+  returns False inside a pool worker.
+- **crash containment**: a worker SIGSEGV/OOM/kill ends one job's process;
+  the supervisor records a classified fault and lives on.
+- **restart with exponential backoff**: a slot whose workers keep dying
+  respawns at ``ABPOA_TPU_POOL_BACKOFF_S`` (default 0.5 s) doubling to a
+  30 s cap; one clean job resets the ladder.
+- **RSS budget** (``ABPOA_TPU_POOL_RSS_MB``): priced by
+  `resilience/memory.py` when unset — the device-byte admission budget
+  (plus runtime baseline) where one is active, or the per-job footprint
+  estimate the serve admission queue already computed; 0 disables. A
+  worker whose heartbeat exceeds the budget is hard-killed before the
+  host OOM killer picks a victim at random.
+- **exactly-once requeue / poison-job quarantine**: a job whose worker
+  DIED (crash, RSS kill, stall kill) is retried once on a fresh worker;
+  a second death quarantines it as a poison job with a structured fault
+  record (`poison_job`) — rc stays 0 while any healthy set succeeded.
+  A DEADLINE kill is terminal immediately: the budget is spent, exactly
+  like a watchdog `DispatchTimeout` (hangs are not retryable).
+
+Worker model: plain subprocesses running ``python -m
+abpoa_tpu.parallel.pool_worker`` speaking length-prefixed pickle frames
+over stdin/stdout — NOT a multiprocessing.Pool. A spawn-context Pool
+re-imports the parent's ``__main__`` in every child (breaks under
+REPL/pytest entry points); a fork-context Pool would inherit a
+half-initialized XLA runtime. The subprocess protocol depends on neither,
+and gives the supervisor a real pid to SIGKILL.
+
+Fault-injection brokering: count-limited ``ABPOA_TPU_INJECT`` budgets are
+leased by the supervisor to one in-flight job at a time and the unfired
+remainder refunded (see `resilience/inject.py`), so ``poison_set:1``
+still means ONE poisoned set across the whole pool run instead of one per
+worker process. The ``worker_kill``/``worker_sigsegv`` kinds fire from
+the supervisor itself: the shot is consumed (and counted) in the parent,
+and the doomed job's dispatch frame carries the tag; subsequent shots of
+the same kind stay bound to that job's retries, which is what makes
+``worker_sigsegv:2`` deterministically produce one twice-crashed —
+quarantined — job.
+
+Telemetry: `abpoa_pool_workers` (live ready workers),
+`abpoa_pool_restarts_total`, `abpoa_pool_kills_total`,
+`abpoa_pool_requeues_total`, `abpoa_pool_poison_jobs_total` and the
+worker compile counters, all materialized at pool start so "zero kills"
+is readable as 0 rather than as an absent family. Worker run-report
+deltas (counters, fault records, breaker state, true-XLA-compile counts)
+merge into the parent report after every job, so `--report`, `--metrics`,
+`abpoa-tpu top` and the chaos assertions see one coherent story even when
+the interesting events happened in a child process.
+
+The pool needs no new compile-ladder rungs: each worker runs the same
+declared K=1 signatures as the in-process drivers, against the shared
+persistent XLA cache — which is also what makes a RESTARTED worker warm
+(cache loads, no recompile burst).
+"""
+from __future__ import annotations
+
+import io
+import itertools
+import os
+import pickle
+import select
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import IO, Dict, List, Optional, Sequence
+
+_FRAME_HDR = struct.Struct("<Q")
+
+# worker-process state, filled by worker_init (runs in the WORKER)
+_W: dict = {}
+
+
+# --------------------------------------------------------------------------- #
+# knobs                                                                       #
+# --------------------------------------------------------------------------- #
+
+def job_deadline_s() -> float:
+    """Per-job hard-kill deadline. Sized like the dispatch watchdog (a
+    cold first-sight compile is minutes and must never trip it); serve
+    jobs override with their request budget. 0 disables."""
+    return float(os.environ.get("ABPOA_TPU_POOL_DEADLINE_S", "900"))
+
+
+def heartbeat_s() -> float:
+    return max(0.05, float(os.environ.get("ABPOA_TPU_POOL_HEARTBEAT_S",
+                                          "1.0")))
+
+
+def stall_s() -> float:
+    """Kill a worker whose heartbeat goes silent this long mid-job. 0
+    (default) disables: a native kernel holding the GIL beats late
+    without being wedged, and the job deadline is the hard bound either
+    way — stall detection is an opt-in early trigger."""
+    return float(os.environ.get("ABPOA_TPU_POOL_STALL_S", "0"))
+
+
+def backoff_base_s() -> float:
+    return float(os.environ.get("ABPOA_TPU_POOL_BACKOFF_S", "0.5"))
+
+
+_BACKOFF_CAP_S = 30.0
+
+
+def restart_backoff_s(consec_deaths: int) -> float:
+    """Exponential respawn backoff: 0 for the first spawn, then
+    base * 2^(n-1) capped at 30 s for consecutive deaths."""
+    if consec_deaths <= 0:
+        return 0.0
+    return min(_BACKOFF_CAP_S, backoff_base_s() * (2 ** (consec_deaths - 1)))
+
+
+def spawn_timeout_s() -> float:
+    return float(os.environ.get("ABPOA_TPU_POOL_SPAWN_TIMEOUT_S", "180"))
+
+
+# worker baseline (interpreter + numpy/jax runtime + graph engine) and the
+# host-side headroom over the DEVICE-byte footprint model: host copies,
+# Python objects and allocator slack make resident bytes a small multiple
+# of the plane estimate
+_BASE_RSS_BYTES = 1_500 * 10 ** 6
+_EST_HEADROOM = 6
+
+
+def rss_limit_bytes(est_bytes: Optional[int] = None) -> int:
+    """Per-worker RSS kill ceiling. ``ABPOA_TPU_POOL_RSS_MB`` wins (0
+    disables); otherwise priced by resilience/memory.py: baseline + the
+    active device admission budget when one exists, else baseline + a
+    headroom multiple of this job's own footprint estimate (the serve
+    admission queue computes one per request), else disabled — host RAM
+    is elastic and a blind default would kill honest big sets."""
+    env = os.environ.get("ABPOA_TPU_POOL_RSS_MB")
+    if env is not None:
+        mb = float(env)
+        return int(mb * 1e6) if mb > 0 else 0
+    from ..resilience import memory
+    budget = memory.budget_bytes()
+    if budget:
+        return _BASE_RSS_BYTES + budget
+    if est_bytes:
+        return _BASE_RSS_BYTES + _EST_HEADROOM * int(est_bytes)
+    return 0
+
+
+def resolve_workers(abpt, n_sets: int) -> int:
+    """Worker-process count for a batch of `n_sets` independent sets:
+    CLI ``--workers`` / `Params.workers` wins, then ``ABPOA_TPU_WORKERS``;
+    auto = one worker per available core (the ROUND8 finding: the K=1
+    engine is the fastest per-set configuration on CPU hosts, so multiple
+    sets scale with processes, not with vmapped lockstep), never more
+    than there are sets.
+
+    Auto NEVER pools device-family backends (jax/tpu/pallas): N worker
+    processes would each open their own accelerator client against the
+    same (often exclusive) device, and the pool branch bypasses the
+    wedged-tunnel probe the in-process path runs first. An explicit
+    --workers / env count is the operator's call and passes through."""
+    w = int(getattr(abpt, "workers", 0) or 0)
+    if w <= 0:
+        env = os.environ.get("ABPOA_TPU_WORKERS", "").strip().lower()
+        if env and env != "auto":
+            try:
+                w = int(env)
+            except ValueError:
+                # a typo'd knob degrades to auto with a warning, never a
+                # traceback mid-batch (same spirit as the CLI's one-line
+                # errors for bad parameters)
+                print(f"Warning: ignoring ABPOA_TPU_WORKERS={env!r} "
+                      "(expected an integer or 'auto')", file=sys.stderr)
+    if w > 0:
+        return max(1, min(w, max(1, n_sets)))
+    if n_sets <= 1 or abpt.device in ("jax", "tpu", "pallas"):
+        return 1
+    try:
+        cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cpus = os.cpu_count() or 1
+    return max(1, min(cpus, n_sets))
+
+
+# --------------------------------------------------------------------------- #
+# frame protocol (shared with pool_worker.py)                                 #
+# --------------------------------------------------------------------------- #
+
+def write_frame(fp, obj) -> None:
+    blob = pickle.dumps(obj)
+    fp.write(_FRAME_HDR.pack(len(blob)) + blob)
+    fp.flush()
+
+
+def _read_exact(fp, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = fp.read(n)
+        if not b:
+            raise EOFError("pool worker closed its pipe")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def read_frame(fp):
+    (n,) = _FRAME_HDR.unpack(_read_exact(fp, _FRAME_HDR.size))
+    return pickle.loads(_read_exact(fp, n))
+
+
+# --------------------------------------------------------------------------- #
+# worker side (executed inside pool_worker.main)                              #
+# --------------------------------------------------------------------------- #
+
+def worker_init(init: dict) -> None:
+    """Runs in the WORKER before the ready handshake: one obs run for the
+    worker's lifetime (so the breaker carries state across jobs exactly
+    like a long-lived serial process), core dumps off (injected SIGSEGVs
+    are a designed failure mode, not a debuggable event), Params
+    unpickled once."""
+    try:
+        import resource
+        resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+    except (ImportError, OSError, ValueError):
+        pass
+    from .. import obs
+    obs.start_run()
+    _W["abpt"] = pickle.loads(init["params"])
+    _W["label"] = init.get("label", "pool")
+
+
+def worker_rss_bytes() -> int:
+    """This process's resident-set size (Linux /proc; 0 = unknown, which
+    disables RSS enforcement for the frame rather than killing blind)."""
+    try:
+        with open("/proc/self/statm") as fp:
+            return int(fp.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                                or 4096)
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def heartbeat_loop(out, wlock: threading.Lock, job_id: int,
+                   stop: threading.Event) -> None:
+    """Beat (job id + RSS) while the job executes. Beats only during
+    execution: an idle worker writing unread frames would eventually fill
+    the pipe and wedge its own result write behind the full buffer."""
+    hb = heartbeat_s()
+    while not stop.wait(hb):
+        try:
+            with wlock:
+                write_frame(out, ("hb", job_id, worker_rss_bytes()))
+        except (OSError, ValueError):
+            return
+
+
+def _report_snapshot():
+    from ..obs import metrics
+    from ..obs import report
+    from ..obs import compile_log as clog
+    rep = report()
+    # the raw record lists (reads, faults, compile records) are the
+    # per-job transport to the parent; clear them each job so a
+    # long-lived worker never hits READS_CAP/FAULTS_CAP/RECORDS_CAP and
+    # silently stops contributing — the parent report owns the
+    # cumulative view
+    with metrics._MUT:
+        del rep.reads[:]
+        rep.reads_dropped = 0
+        del rep.faults[:]
+        rep.faults_dropped = 0
+        del clog._RECORDS[:]
+        sk = rep.wall_sketch
+        # sketch buckets + backend/fallback attribution are cumulative
+        # (never cleared): snapshot them so the delta carries EVERY read
+        # of the job, not just the READS_CAP-bounded raw list
+        reads_agg0 = (list(sk.counts), sk.count, sk.sum,
+                      dict(rep.read_backends), dict(rep.read_fallbacks),
+                      rep.reads_amortized)
+    return (dict(rep.counters),
+            {k: tuple(v) for k, v in rep.phases.items()},
+            {k: tuple(v) for k, v in rep.values.items()},
+            reads_agg0)
+
+
+def _report_delta(snap) -> dict:
+    """What one job changed in this worker's run report: counter/phase/
+    value deltas, per-read records, new fault records, current breaker-
+    degradation state, and the job's compile story split into true XLA
+    compiles vs persistent-cache loads (the recompile-burst signal the
+    serve smoke asserts on)."""
+    from ..obs import metrics
+    from ..obs import report
+    from ..obs import compile_log as clog
+    rep = report()
+    c0, p0, v0, (sc0, sn0, ss0, bk0, fb0, am0) = snap
+    counters = {}
+    for k, v in rep.counters.items():
+        d = v - c0.get(k, 0)
+        if d:
+            counters[k] = d
+    phases = {}
+    for k, (w, c) in rep.phases.items():
+        w0, c0p = p0.get(k, (0.0, 0))
+        if c != c0p or w != w0:
+            phases[k] = [w - w0, c - c0p]
+    values = {}
+    for k, (n, tot, vmin, vmax) in rep.values.items():
+        n0, tot0, _m0, _x0 = v0.get(k, (0, 0.0, 0.0, 0.0))
+        if n != n0:
+            # min/max of the job alone are unknowable from cumulative
+            # state; the whole-worker extremes are a safe superset
+            values[k] = [n - n0, tot - tot0, vmin, vmax]
+    xla = loads = 0
+    for rec in clog._RECORDS:
+        if not rec.get("cache_hit"):
+            # only a positively-witnessed persistent-cache hit counts as a
+            # load; None (cache disabled / no monitoring events) means the
+            # compile really ran from scratch — counting it as a load would
+            # let the serve-smoke recompile-burst gate pass vacuously
+            if rec.get("persistent_cache_hit") is True:
+                loads += 1
+            else:
+                xla += 1
+    with metrics._MUT:
+        sk = rep.wall_sketch
+        # aggregate view of EVERY read this job recorded — the raw list
+        # below is capped at READS_CAP, and a replay of it alone would
+        # silently undercount the parent's percentiles/counts past the
+        # cap. Job-local min/max are unknowable from cumulative state;
+        # the worker-lifetime extremes are a safe superset (same
+        # convention as the values merge above)
+        reads_agg = {
+            "counts": {i: c - sc0[i] for i, c in enumerate(sk.counts)
+                       if c != sc0[i]},
+            "count": sk.count - sn0, "sum": sk.sum - ss0,
+            "min": sk.min, "max": sk.max,
+            "backends": {b: n - bk0.get(b, 0)
+                         for b, n in rep.read_backends.items()
+                         if n != bk0.get(b, 0)},
+            "fallbacks": {f: n - fb0.get(f, 0)
+                          for f, n in rep.read_fallbacks.items()
+                          if n != fb0.get(f, 0)},
+            "amortized": rep.reads_amortized - am0,
+            "dropped": rep.reads_dropped,
+        }
+    return {"counters": counters,
+            "phases": phases,
+            "values": values,
+            "read_records": [tuple(r) for r in rep.reads],
+            "reads_agg": reads_agg,
+            "faults": list(rep.faults),
+            "degraded": {b: dict(i) for b, i in rep.degraded.items()},
+            "xla_compiles": xla, "cache_loads": loads}
+
+
+def _test_delay_s() -> float:
+    """Per-job service-time shim (ABPOA_TPU_POOL_DELAY_S): makes "a job is
+    in flight" a deterministic window for the drain/deadline tests, same
+    spirit as ABPOA_TPU_SERVE_DELAY_S."""
+    return float(os.environ.get("ABPOA_TPU_POOL_DELAY_S", "0") or 0)
+
+
+def run_file(payload) -> dict:
+    """One `-l` batch job: file -> output text, with the same per-set
+    quarantine boundary the serial runner applies (the fault record and
+    stderr line are produced HERE and merged to the parent)."""
+    from .. import resilience as rz
+    from ..pipeline import Abpoa, msa_from_file
+    idx, fn = payload
+    abpt = _W["abpt"]
+    abpt.batch_index = idx + 1
+    buf = io.StringIO()
+    quarantined = None
+    try:
+        msa_from_file(Abpoa(), abpt, fn, buf)
+    except rz.QUARANTINE_EXCEPTIONS as e:
+        rz.quarantine_set(idx, fn, e)
+        quarantined = (type(e).__name__, str(e)[:300])
+    return {"idx": idx, "text": buf.getvalue(), "quarantined": quarantined}
+
+
+def run_records(payload) -> dict:
+    """One serve job: in-memory records -> the same bytes `_run_single`
+    would produce in-process (the byte-identity contract of the smoke)."""
+    from .. import resilience as rz
+    from ..pipeline import Abpoa, msa
+    from ..serve.server import _test_delay_s as serve_delay_s
+    (records,) = payload
+    delay = serve_delay_s()  # one parser for the serve-path delay shim
+    if delay:
+        time.sleep(delay)
+    buf = io.StringIO()
+    quarantined = None
+    try:
+        msa(Abpoa(), _W["abpt"], records, buf)
+    except rz.QUARANTINE_EXCEPTIONS as e:
+        from ..obs import record_fault
+        record_fault("poisoned_set", detail=str(e)[:300],
+                     action="rejected_400")
+        quarantined = (type(e).__name__, str(e)[:300])
+    return {"text": buf.getvalue(), "quarantined": quarantined}
+
+
+_TASKS = {"file": run_file, "records": run_records}
+
+
+def worker_run_job(job_id: int, kind: str, payload, spec: str,
+                   kill_kind: Optional[str]):
+    """Execute one job frame in the worker. `spec` is the injection lease
+    the supervisor brokered for THIS job; `kill_kind` is a supervisor-
+    fired worker-death injector — die first, run never."""
+    from ..resilience import inject
+    if kill_kind:
+        sig = (signal.SIGKILL if kill_kind == "worker_kill"
+               else signal.SIGSEGV)
+        os.kill(os.getpid(), sig)
+        time.sleep(10)  # signal delivery can lag; never answer the frame
+    inject.configure(spec or "")
+    delay = _test_delay_s()
+    if delay:
+        time.sleep(delay)
+    snap = _report_snapshot()
+    result = _TASKS[kind](payload)
+    result["extract"] = _report_delta(snap)
+    return "ok", job_id, result
+
+
+# --------------------------------------------------------------------------- #
+# parent side                                                                 #
+# --------------------------------------------------------------------------- #
+
+class PoolWorkerError(RuntimeError):
+    """A worker reported an unclassified failure; the batch runner
+    re-raises it (real bugs must propagate, same as serial)."""
+
+
+class PoolJob:
+    """One unit of pool work moving toward a terminal status:
+    ok | timeout | poison | error | cancelled."""
+
+    _ids = itertools.count(1)
+
+    __slots__ = ("id", "kind", "payload", "label", "deadline_s",
+                 "deadline_ts", "est_bytes", "attempts", "status",
+                 "result", "error", "done", "t_submit", "leases")
+
+    def __init__(self, kind: str, payload, label: str = "",
+                 deadline_s: Optional[float] = None,
+                 est_bytes: Optional[int] = None) -> None:
+        self.id = next(self._ids)
+        self.kind = kind
+        self.payload = payload
+        self.label = label or f"job-{self.id}"
+        self.deadline_s = deadline_s
+        # an EXPLICIT deadline is a wall budget from submission (a serve
+        # request's remaining_s): it spans queue wait, every attempt and
+        # respawn backoff — a requeue must not reset the clock. Jobs
+        # without one get the pool default per ATTEMPT instead (batch
+        # jobs queue behind each other for unbounded, healthy time).
+        self.deadline_ts = (time.monotonic() + deadline_s
+                            if deadline_s is not None and deadline_s > 0
+                            else None)
+        self.est_bytes = est_bytes
+        self.attempts = 0
+        self.status: Optional[str] = None
+        self.result: dict = {}
+        self.error = ""
+        self.done = threading.Event()
+        self.t_submit = time.perf_counter()
+        self.leases: Dict[str, int] = {}
+
+    def finish(self, status: str, result: Optional[dict] = None,
+               error: str = "") -> None:
+        if self.status is not None:
+            return
+        self.status = status
+        if result is not None:
+            self.result = result
+        self.error = error
+        self.done.set()
+
+    def wall_s(self) -> float:
+        return time.perf_counter() - self.t_submit
+
+
+class _Slot:
+    """One worker seat: at most one live process, one supervisor thread."""
+
+    __slots__ = ("proc", "stdin", "stdout", "pid", "ready", "spawned",
+                 "consec_deaths", "rss", "retired")
+
+    def __init__(self) -> None:
+        self.proc = None
+        self.stdin = None
+        self.stdout = None
+        self.pid = 0
+        self.ready = False
+        self.spawned = 0
+        self.consec_deaths = 0
+        self.rss = 0
+        self.retired = False
+
+
+class WorkerPool:
+    """The supervisor: N slots x (spawn, dispatch, watch, kill, respawn)."""
+
+    def __init__(self, n_workers: int, abpt, label: str = "pool",
+                 default_deadline_s: Optional[float] = None) -> None:
+        self.n_workers = max(1, int(n_workers))
+        self.label = label
+        self._default_deadline = (default_deadline_s
+                                  if default_deadline_s is not None
+                                  else job_deadline_s())
+        self._params_blob = pickle.dumps(abpt)
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._closing = False
+        self._aborting = False
+        self._draining = False
+        self._slots = [_Slot() for _ in range(self.n_workers)]
+        self._threads: List[threading.Thread] = []
+        self._state = threading.Lock()
+        self._kill_bound: Optional[int] = None
+        self._slot_degraded: Dict[int, dict] = {}
+        self._deg_counts: Dict[str, int] = {}
+        # pool-local mirrors of the process-cumulative obs counters, for
+        # /healthz and snapshot()
+        self._counts = {"restarts": 0, "kills": 0, "requeues": 0,
+                        "poison_jobs": 0, "crashes": 0, "jobs": 0}
+        self._stall = stall_s()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        from ..obs import metrics
+        metrics.materialize_pool_families()
+        for si in range(self.n_workers):
+            t = threading.Thread(target=self._supervise, args=(si,),
+                                 daemon=True,
+                                 name=f"abpoa-pool-{self.label}-{si}")
+            t.start()
+            self._threads.append(t)
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        """Block until every slot's worker answered the ready handshake
+        (or timeout). Optional — jobs queue safely before readiness."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if sum(1 for s in self._slots if s.ready) >= self.n_workers:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def submit(self, kind: str, payload, label: str = "",
+               deadline_s: Optional[float] = None,
+               est_bytes: Optional[int] = None) -> PoolJob:
+        job = PoolJob(kind, payload, label=label, deadline_s=deadline_s,
+                      est_bytes=est_bytes)
+        with self._cv:
+            if self._closing or self._draining:
+                job.finish("cancelled", error="pool is draining")
+                return job
+            self._queue.append(job)
+            self._cv.notify()
+        return job
+
+    def drain_intake(self) -> int:
+        """SIGTERM drain: cancel every QUEUED job (they never started),
+        let in-flight jobs finish. Returns the number cancelled."""
+        with self._cv:
+            self._draining = True
+            cancelled = 0
+            while self._queue:
+                self._queue.popleft().finish("cancelled",
+                                             error="drained on signal")
+                cancelled += 1
+            self._cv.notify_all()
+        return cancelled
+
+    def close(self, graceful: bool = True, timeout: float = 30.0) -> None:
+        """Tear the pool down. graceful: in-flight jobs finish, workers
+        get a shutdown frame; else everything is SIGKILLed now and
+        unfinished jobs become `cancelled`."""
+        with self._cv:
+            self._closing = True
+            if not graceful:
+                self._aborting = True
+            while self._queue:
+                self._queue.popleft().finish("cancelled",
+                                             error="pool closed")
+            self._cv.notify_all()
+        if not graceful:
+            for s in self._slots:
+                self._kill_proc(s)
+        for t in self._threads:
+            t.join(timeout=timeout)
+        for s in self._slots:  # belt: no worker survives close()
+            self._kill_proc(s)
+        self._publish_up()
+
+    def snapshot(self) -> dict:
+        with self._state:
+            return {
+                "target": self.n_workers,
+                "workers": sum(1 for s in self._slots if s.ready),
+                "pids": [s.pid for s in self._slots if s.ready],
+                **dict(self._counts),
+            }
+
+    # ------------------------------------------------------------ internals
+    def _publish_up(self) -> None:
+        from ..obs import metrics
+        if metrics.enabled():
+            metrics.publish_pool_workers(
+                sum(1 for s in self._slots if s.ready))
+
+    def _bump(self, key: str, counter: Optional[str] = None,
+              n: int = 1) -> None:
+        with self._state:
+            self._counts[key] = self._counts.get(key, 0) + n
+        if counter:
+            from ..obs import count
+            count(counter, n)
+
+    def _next_job(self, si: int) -> Optional[PoolJob]:
+        while True:
+            with self._cv:
+                if self._queue:
+                    return self._queue.popleft()
+                if self._closing or self._draining:
+                    return None
+                self._cv.wait(0.25)
+            # heal the slot NOW: a SIGKILLed idle worker must show up as
+            # a crash + respawn in /healthz (not lie ready until the next
+            # job trips over its corpse), and a slot emptied by a hard
+            # kill must regain capacity before the next job, not because
+            # of it
+            self._heal_slot(si)
+
+    def _heal_slot(self, si: int) -> None:
+        if self._closing or self._draining:
+            return
+        slot = self._slots[si]
+        if (slot.proc is not None and slot.ready
+                and slot.proc.poll() is not None):
+            self._note_death(si, None)
+        if slot.proc is None or slot.proc.poll() is not None:
+            # opportunistic: one spawn attempt per idle tick (backoff
+            # still applies) — a permanently-broken worker command must
+            # not spawn-storm from the heal loop
+            self._ensure_worker(si, max_attempts=1)
+
+    def _requeue_front(self, job: PoolJob) -> None:
+        with self._cv:
+            if self._closing or self._draining:
+                job.finish("cancelled", error="pool is draining")
+                return
+            self._queue.appendleft(job)
+            self._cv.notify()
+
+    def _supervise(self, si: int) -> None:
+        # eager spawn: serve wants warm workers before the first request
+        # arrives (wait_ready), and a batch has its jobs queued already
+        self._ensure_worker(si)
+        while True:
+            job = self._next_job(si)
+            if job is None:
+                break
+            try:
+                self._execute(si, job)
+            except Exception as exc:  # noqa: BLE001 — supervisor must live
+                # the containment layer cannot itself lose a job: an escaped
+                # exception becomes the job's error (finish is idempotent),
+                # never an unset done event that wedges its waiter
+                from ..obs import record_fault
+                record_fault("supervisor_error", detail=f"{job.label}: "
+                             f"{type(exc).__name__}: {exc}"[:300],
+                             action="propagated")
+                self._refund_leases(job, fired=None)
+                self._unbind_kill(job)
+                self._kill_proc(self._slots[si])
+                job.finish("error",
+                           error=f"pool supervisor error: "
+                                 f"{type(exc).__name__}: {exc}")
+            if self._slots[si].retired and self._other_live_slot(si):
+                # leave the dispatch rotation to the live slots; the last
+                # remaining supervisor keeps running so queued jobs still
+                # terminate (as errors) instead of hanging
+                break
+        self._shutdown_slot(si)
+
+    def _other_live_slot(self, si: int) -> bool:
+        """Any slot besides `si` not permanently retired? Serialized so
+        two concurrently-retiring slots cannot both defer to each other
+        and leave the queue unsupervised."""
+        with self._state:
+            return any(not s.retired
+                       for j, s in enumerate(self._slots) if j != si)
+
+    # ---------------------------------------------------------- spawning
+    def _worker_env(self) -> dict:
+        from .. import resilience as rz
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(
+                os.pathsep) if p])
+        # the supervisor's SIGKILL deadline replaces thread abandonment
+        env["ABPOA_TPU_POOL_WORKER"] = "1"
+        # injection budgets are brokered per job by the supervisor; the
+        # raw env spec would re-arm a full budget in every worker
+        env["ABPOA_TPU_INJECT"] = ""
+        # the parent owns the archive records (exactly one per job)
+        env["ABPOA_TPU_ARCHIVE"] = "0"
+        # the parent already made the device decision this pool runs under
+        env.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+        env["ABPOA_TPU_RESILIENCE"] = "1" if rz.enabled() else "0"
+        return env
+
+    def _spawn(self, slot: _Slot) -> bool:
+        """One spawn attempt: process, init frame, ready handshake."""
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "abpoa_tpu.parallel.pool_worker"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=self._worker_env(), bufsize=0)
+        except OSError:
+            return False
+        slot.proc, slot.stdin, slot.stdout = proc, proc.stdin, proc.stdout
+        slot.pid = proc.pid
+        slot.spawned += 1
+        if slot.spawned > 1:
+            self._bump("restarts", "pool.restarts")
+        try:
+            write_frame(slot.stdin, {"params": self._params_blob,
+                                     "label": self.label})
+            deadline = time.monotonic() + spawn_timeout_s()
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise EOFError("ready handshake timed out")
+                r, _, _ = select.select([slot.stdout], [], [],
+                                        min(0.25, left))
+                if self._aborting:
+                    raise EOFError("pool aborted during spawn")
+                if r:
+                    frame = read_frame(slot.stdout)
+                    if frame and frame[0] == "ready":
+                        break
+        except (EOFError, OSError, ValueError):
+            self._kill_proc(slot)
+            return False
+        slot.ready = True
+        self._publish_up()
+        return True
+
+    # consecutive failed spawn ATTEMPTS (no ready handshake ever) before a
+    # slot is retired — a worker that can never start must surface as an
+    # error on its jobs, not wedge the run in an infinite respawn loop
+    MAX_SPAWN_FAILURES = 5
+
+    def _ensure_worker(self, si: int,
+                       max_attempts: Optional[int] = None) -> bool:
+        """Live ready worker in slot `si`, spawning (with backoff) as
+        needed. False when the pool is closing or the slot is RETIRED —
+        permanently, after MAX_SPAWN_FAILURES consecutive spawns never
+        reached a ready handshake (a worker command that cannot start
+        must fast-fail its jobs, not stall every one of them through the
+        full backoff ladder)."""
+        if max_attempts is None:
+            max_attempts = self.MAX_SPAWN_FAILURES
+        slot = self._slots[si]
+        if slot.retired:
+            return False
+        spawn_fails = 0
+        while True:
+            if self._aborting or self._closing:
+                return False
+            if slot.proc is not None and slot.proc.poll() is None \
+                    and slot.ready:
+                return True
+            self._kill_proc(slot)
+            if spawn_fails >= max_attempts:
+                if max_attempts >= self.MAX_SPAWN_FAILURES:
+                    with self._state:  # ordered vs _other_live_slot reads
+                        slot.retired = True
+                    from ..obs import record_fault
+                    record_fault(
+                        "worker_spawn_failed",
+                        detail=f"slot {si}: retired after "
+                               f"{spawn_fails} consecutive spawn "
+                               "failures", action="slot_retired")
+                return False
+            delay = restart_backoff_s(slot.consec_deaths)
+            deadline = time.monotonic() + delay
+            while time.monotonic() < deadline:
+                if self._aborting or self._closing:
+                    return False
+                time.sleep(min(0.1, deadline - time.monotonic()))
+            if self._spawn(slot):
+                return True
+            spawn_fails += 1
+            slot.consec_deaths += 1
+
+    def _kill_proc(self, slot: _Slot) -> None:
+        proc = slot.proc
+        if proc is None:
+            return
+        try:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        for fp in (slot.stdin, slot.stdout):
+            try:
+                if fp:
+                    fp.close()
+            except OSError:
+                pass
+        slot.proc = slot.stdin = slot.stdout = None
+        slot.ready = False
+        self._publish_up()
+
+    def _shutdown_slot(self, si: int) -> None:
+        slot = self._slots[si]
+        if slot.proc is None:
+            return
+        try:
+            write_frame(slot.stdin, None)
+            slot.proc.wait(timeout=10)
+        except (OSError, ValueError, subprocess.TimeoutExpired):
+            pass
+        self._kill_proc(slot)
+
+    # ---------------------------------------------------------- injection
+    def _lease_kill(self, job: PoolJob) -> Optional[str]:
+        """Worker-death injectors fire from the supervisor: consume one
+        shot and bind remaining shots of the kind to this job's retries
+        so `worker_sigsegv:2` crashes ONE job twice instead of two jobs
+        once. The firing is COUNTED only when the death is observed
+        (_execute's EOF path) — a tag whose dispatch frame never reached
+        a worker is refunded, not fired."""
+        from ..resilience import inject
+        with self._state:
+            if self._kill_bound is not None and self._kill_bound != job.id:
+                return None
+            for kind in inject.WORKER_KINDS:
+                if inject.lease(kind, 1):
+                    self._kill_bound = job.id
+                    return kind
+        return None
+
+    def _unbind_kill(self, job: PoolJob) -> None:
+        """Release the worker-kill binding when the bound job goes
+        terminal: leftover shots (e.g. worker_sigsegv:3 after its victim
+        was quarantined at 2 crashes) move on to the next job instead of
+        stranding unfired."""
+        with self._state:
+            if self._kill_bound == job.id:
+                self._kill_bound = None
+
+    def _build_spec(self, job: PoolJob) -> str:
+        """The injection spec THIS job's worker arms: unlimited kinds
+        forwarded verbatim, count-limited kinds leased in full to one
+        job at a time (single-process firing-order semantics: the first
+        dispatchee consumes the budget, unfired shots are refunded on
+        completion and migrate to a later job)."""
+        from ..resilience import inject
+        parts = []
+        with self._state:
+            for kind, left in inject.snapshot().items():
+                if kind in inject.WORKER_KINDS:
+                    continue
+                if left == -1:
+                    parts.append(kind)
+                elif left > 0:
+                    n = inject.lease(kind)
+                    if n:
+                        job.leases[kind] = n
+                        parts.append(f"{kind}:{n}")
+        return ",".join(parts)
+
+    def _refund_leases(self, job: PoolJob,
+                       fired: Optional[dict]) -> None:
+        """Return the lease minus what actually fired. `fired=None` means
+        the worker died mid-job: the shots are burned (refunding them
+        could re-kill healthy jobs forever)."""
+        from ..resilience import inject
+        leases, job.leases = job.leases, {}
+        if fired is None:
+            return
+        for kind, n in leases.items():
+            used = int(fired.get(f"inject.{kind}", 0))
+            inject.refund(kind, max(0, n - used))
+
+    # ---------------------------------------------------------- merging
+    def _merge_reads(self, records, agg: dict) -> None:
+        """Fold one job's read-latency story into the parent: sketch
+        buckets, backend/fallback attribution and the drop count cover
+        every read; the raw records fill the parent's bounded list under
+        its own cap. Same end state record_read would have produced had
+        each read run in-process."""
+        from ..obs import metrics, report
+        from ..obs.report import READS_CAP
+        rep = report()
+        if not rep.enabled:
+            return
+        tmp = metrics.LogSketch()
+        for i, c in (agg.get("counts") or {}).items():
+            tmp.counts[int(i)] = int(c)
+        tmp.count = int(agg.get("count") or 0)
+        tmp.sum = float(agg.get("sum") or 0.0)
+        if tmp.count:
+            tmp.min = float(agg.get("min"))
+            tmp.max = float(agg.get("max"))
+        with metrics._MUT:
+            if tmp.count:
+                rep.wall_sketch.merge(tmp)
+            for b, n in (agg.get("backends") or {}).items():
+                rep.read_backends[b] = rep.read_backends.get(b, 0) + n
+            for f, n in (agg.get("fallbacks") or {}).items():
+                rep.read_fallbacks[f] = rep.read_fallbacks.get(f, 0) + n
+            rep.reads_amortized += int(agg.get("amortized") or 0)
+            for r in records:
+                if len(rep.reads) < READS_CAP:
+                    rep.reads.append(tuple(r))
+                else:
+                    rep.reads_dropped += 1
+            rep.reads_dropped += int(agg.get("dropped") or 0)
+        if metrics.enabled():
+            metrics.publish_read_aggregate(agg.get("backends") or {},
+                                           agg.get("fallbacks") or {},
+                                           tmp)
+
+    def _merge_extract(self, si: int, ext: dict) -> None:
+        """Fold one worker job's report delta into the parent report +
+        fleet registry — the parent report is the one `--report`, the
+        archive and the chaos assertions read, even when the breaker
+        tripped inside a worker process."""
+        from ..obs import count, metrics, record_fault, record_read, report
+        for name, v in (ext.get("counters") or {}).items():
+            # faults.<kind> counters re-materialize via record_fault below
+            if name.startswith("faults."):
+                continue
+            if isinstance(v, (int, float)) and v:
+                count(name, v)
+        for name, (w, c) in (ext.get("phases") or {}).items():
+            report().merge_phase(name, w, c)
+        for name, v in (ext.get("values") or {}).items():
+            report().merge_value(name, *v)
+        agg = ext.get("reads_agg")
+        if agg is not None:
+            # aggregate merge: sketch buckets + attribution cover EVERY
+            # read of the job (a raw-record replay would undercount past
+            # the worker's READS_CAP); the raw records only feed the
+            # parent's bounded qlen/band attribution list
+            self._merge_reads(ext.get("read_records") or [], agg)
+        else:
+            for r in ext.get("read_records") or []:
+                # (wall_s, qlen, band_cols, backend, fallback, amortized)
+                record_read(*r)
+        for rec in ext.get("faults") or []:
+            record_fault(rec.get("kind", "worker_fault"),
+                         backend=rec.get("backend"),
+                         set_index=rec.get("set"),
+                         detail=rec.get("detail", ""),
+                         action=rec.get("action", ""))
+        if ext.get("xla_compiles"):
+            count("pool.worker_xla_compiles", int(ext["xla_compiles"]))
+        if ext.get("cache_loads"):
+            count("pool.worker_cache_loads", int(ext["cache_loads"]))
+        new_deg = ext.get("degraded") or {}
+        with self._state:
+            old = self._slot_degraded.get(si, {})
+            opened = [b for b in new_deg if b not in old]
+            closed = [b for b in old if b not in new_deg]
+            recloses = []
+            for b in opened:
+                self._deg_counts[b] = self._deg_counts.get(b, 0) + 1
+            for b in closed:
+                self._deg_counts[b] = self._deg_counts.get(b, 1) - 1
+                if self._deg_counts[b] <= 0:
+                    recloses.append(b)
+            self._slot_degraded[si] = dict(new_deg)
+        for b in opened:
+            info = new_deg[b]
+            report().mark_degraded(
+                b, info.get("to", "?"),
+                f"pool worker: {info.get('reason', 'breaker open')}",
+                int(info.get("failures", 0)))
+            if metrics.enabled():
+                metrics.set_breaker_state(b, True)
+        for b in recloses:
+            report().mark_reclosed(b)
+            if metrics.enabled():
+                metrics.set_breaker_state(b, False)
+
+    def _drop_slot_degraded(self, si: int) -> None:
+        """A dead worker's breaker state dies with it."""
+        from ..obs import metrics, report
+        with self._state:
+            old = self._slot_degraded.pop(si, {})
+            recloses = []
+            for b in old:
+                self._deg_counts[b] = self._deg_counts.get(b, 1) - 1
+                if self._deg_counts[b] <= 0:
+                    recloses.append(b)
+        for b in recloses:
+            report().mark_reclosed(b)
+            if metrics.enabled():
+                metrics.set_breaker_state(b, False)
+
+    # ---------------------------------------------------------- execution
+    def _execute(self, si: int, job: PoolJob) -> None:
+        from ..obs import record_fault
+        slot = self._slots[si]
+        if (job.deadline_ts is not None
+                and time.monotonic() >= job.deadline_ts):
+            # the wall budget expired while queued / between attempts:
+            # terminal now — dispatching would only kill a healthy worker
+            record_fault("job_deadline", detail=job.label,
+                         action="expired_before_dispatch")
+            self._unbind_kill(job)
+            job.finish("timeout",
+                       error=f"{job.label}: deadline expired before "
+                             "dispatch")
+            return
+        if not self._ensure_worker(si):
+            if self._closing or self._aborting:
+                self._unbind_kill(job)
+                job.finish("cancelled", error="pool closed before dispatch")
+                return
+            if self._slots[si].retired and self._other_live_slot(si):
+                # a retired slot must not out-race healthy workers for the
+                # queue: hand the job back (binding intact, no attempt
+                # charged) — _supervise exits this slot's rotation next
+                self._requeue_front(job)
+                return
+            # every slot is retired (or this is the only one): a worker
+            # that can never start is a real bug — surface it, don't hang
+            self._unbind_kill(job)
+            record_fault("worker_spawn_failed", detail=job.label,
+                         action="propagated")
+            job.finish("error",
+                       error=f"pool worker failed to start "
+                             f"({self.MAX_SPAWN_FAILURES} attempts)")
+            return
+        job.attempts += 1
+        kill_kind = self._lease_kill(job)
+        spec = self._build_spec(job)
+        try:
+            write_frame(slot.stdin,
+                        ("job", job.id, job.kind, job.payload, spec,
+                         kill_kind))
+        except (OSError, ValueError):
+            # the worker died while IDLE: not this job's doing — no
+            # attempt charged, leases refunded, straight back to the front
+            self._note_death(si, None)
+            job.attempts -= 1
+            self._refund_leases(job, fired={})
+            if kill_kind:
+                from ..resilience import inject
+                with self._state:  # the kill tag never reached a worker
+                    self._kill_bound = None
+                    inject.refund(kill_kind, 1)
+            self._requeue_front(job)
+            return
+        if job.deadline_ts is not None:
+            deadline_ts = job.deadline_ts     # wall budget from submit
+            deadline = job.deadline_s
+        else:
+            deadline = self._default_deadline
+            deadline_ts = (time.monotonic() + deadline
+                           if deadline > 0 else None)
+        limit = rss_limit_bytes(job.est_bytes)
+        last_beat = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if self._aborting:
+                self._kill_proc(slot)
+                self._refund_leases(job, fired=None)
+                self._unbind_kill(job)
+                job.finish("cancelled", error="pool aborted")
+                return
+            if deadline_ts is not None and now >= deadline_ts:
+                self._hard_kill(si, job, "deadline",
+                                f"no result within {deadline:.1f}s job "
+                                "deadline (hard SIGKILL replaces thread "
+                                "abandonment)")
+                # the budget is spent: terminal, same contract as a
+                # watchdog DispatchTimeout (hangs are not retryable).
+                # The lease dies with the worker (fired counts unknowable)
+                self._refund_leases(job, fired=None)
+                self._unbind_kill(job)
+                job.finish("timeout",
+                           error=f"{job.label}: killed at the "
+                                 f"{deadline:.1f}s job deadline")
+                return
+            if self._stall and now - last_beat > self._stall:
+                self._hard_kill(si, job, "stall",
+                                f"heartbeat silent for {self._stall:.1f}s")
+                # burn the lease: what fired in the stalled worker is
+                # unknowable, and a refund could re-kill healthy jobs
+                self._refund_leases(job, fired=None)
+                self._after_death(job, "stalled heartbeat")
+                return
+            tick = 0.25 if deadline_ts is None else min(
+                0.25, max(0.01, deadline_ts - now))
+            try:
+                r, _, _ = select.select([slot.stdout], [], [], tick)
+            except (OSError, TypeError, ValueError):
+                # closed/None stdout (concurrent _kill_proc): fall through
+                # to read_frame, whose death path owns the cleanup
+                r = [slot.stdout]
+            if not r:
+                continue
+            try:
+                frame = read_frame(slot.stdout)
+            except (EOFError, OSError, ValueError, AttributeError):
+                if kill_kind:
+                    # the injected death happened: counted at observation
+                    # (the worker cannot count its own SIGKILL)
+                    from ..obs import count
+                    count(f"inject.{kill_kind}")
+                self._note_death(si, job)
+                self._refund_leases(job, fired=None)
+                self._after_death(job, "worker died mid-job")
+                return
+            last_beat = time.monotonic()
+            tag = frame[0]
+            if tag == "hb":
+                slot.rss = int(frame[2] or 0)
+                if limit and slot.rss > limit:
+                    self._hard_kill(
+                        si, job, "rss",
+                        f"worker RSS {slot.rss} B over the "
+                        f"{limit} B budget")
+                    # same burn as every worker death: fired unknowable
+                    self._refund_leases(job, fired=None)
+                    self._after_death(job, "RSS budget exceeded")
+                    return
+                continue
+            if tag == "ok" and frame[1] == job.id:
+                result = frame[2] or {}
+                extract = result.pop("extract", None)
+                if extract:
+                    self._merge_extract(si, extract)
+                self._refund_leases(
+                    job, fired=(extract or {}).get("counters") or {})
+                self._unbind_kill(job)
+                slot.consec_deaths = 0
+                self._bump("jobs", "pool.jobs")
+                job.finish("ok", result=result)
+                return
+            if tag == "err" and frame[1] == job.id:
+                # firings before the failure are unknowable: burn the
+                # lease rather than risk re-firing consumed shots
+                self._refund_leases(job, fired=None)
+                self._unbind_kill(job)
+                slot.consec_deaths = 0
+                record_fault("worker_error", detail=str(frame[2])[:300],
+                             action="propagated")
+                job.finish("error", error=str(frame[2]))
+                return
+            # unknown/stale frame: drop it, keep watching
+
+    def _hard_kill(self, si: int, job: PoolJob, why: str,
+                   detail: str) -> None:
+        from ..obs import record_fault
+        slot = self._slots[si]
+        self._bump("kills", "pool.kills")
+        record_fault("worker_killed", set_index=None,
+                     detail=f"{job.label}: {detail}", action=f"kill_{why}")
+        slot.consec_deaths += 1
+        self._kill_proc(slot)
+        self._drop_slot_degraded(si)
+
+    def _note_death(self, si: int, job: Optional[PoolJob]) -> None:
+        """A worker died on its own (signal, unexpected exit)."""
+        from ..obs import record_fault
+        slot = self._slots[si]
+        rc = None
+        if slot.proc is not None:
+            try:
+                rc = slot.proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        desc = f"exit {rc}"
+        if rc is not None and rc < 0:
+            try:
+                desc = f"signal {signal.Signals(-rc).name}"
+            except ValueError:
+                desc = f"signal {-rc}"
+        self._bump("crashes", "pool.worker_crashes")
+        record_fault("worker_crash",
+                     detail=(f"{job.label}: " if job else "")
+                     + f"worker pid {slot.pid} died ({desc})",
+                     action="respawn")
+        slot.consec_deaths += 1
+        self._kill_proc(slot)
+        self._drop_slot_degraded(si)
+
+    def _after_death(self, job: PoolJob, why: str) -> None:
+        """Exactly-once requeue: first death retries on a fresh worker,
+        the second quarantines the job as poison."""
+        from ..obs import count, record_fault
+        if job.attempts >= 2:
+            self._unbind_kill(job)
+            self._bump("poison_jobs", "pool.poison_jobs")
+            count("quarantine.sets")
+            record_fault("poison_job",
+                         detail=f"{job.label}: {why} on attempt "
+                                f"{job.attempts}; quarantined",
+                         action="quarantined")
+            print(f"Warning: pool job {job.label!r} killed its worker "
+                  f"{job.attempts} times ({why}); quarantined as a "
+                  "poison job.", file=sys.stderr)
+            job.finish("poison", error=f"{why} (x{job.attempts})")
+            return
+        self._bump("requeues", "pool.requeues")
+        self._requeue_front(job)
+
+
+# --------------------------------------------------------------------------- #
+# the `-l` batch runner                                                       #
+# --------------------------------------------------------------------------- #
+
+def _archive_job(job: PoolJob, abpt, status: str) -> None:
+    """One archive record per job TERMINAL status (idempotent across
+    requeues by construction: only the terminal write exists) — the
+    window `abpoa-tpu slo` evaluates, same field shapes as the serve
+    per-request records."""
+    from .. import obs
+    obs.archive.append_record({
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kind": "pool_job",
+        "label": job.label,
+        "device": abpt.device,
+        "status": status,
+        "attempts": job.attempts,
+        "total_wall_s": round(job.wall_s(), 6),
+        "reads": 0,
+        "faults": 1 if status != "ok" else 0,
+        "quarantined": 1 if status != "ok" else 0,
+    })
+
+
+def run_pool_batch(files: Sequence[str], abpt, out_fp: IO[str],
+                   n_workers: int) -> dict:
+    """The pool `-l` runner: one job per read-set file, fanned over
+    supervised worker processes, outputs emitted in file order so the
+    bytes match sequential processing exactly. Returns the same
+    {"sets", "quarantined"} stats dict as the serial runner (plus
+    "cancelled" after a SIGTERM drain)."""
+    from ..obs import count, metrics, observe
+    stats = {"sets": len(files), "quarantined": 0}
+    if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
+        return stats  # mirror msa_from_file: nothing to emit or compute
+    pool = WorkerPool(n_workers, abpt, label="batch")
+    count("pool.runs")
+    observe("pool.workers", pool.n_workers)
+    metrics.publish_batch_progress(0, total=len(files))
+    jobs = [pool.submit("file", (i, fn), label=fn)
+            for i, fn in enumerate(files)]
+    # graceful drain on SIGTERM: queued jobs are cancelled, in-flight
+    # jobs finish, completed output is emitted, rc stays 0 (main-thread
+    # CLI runs only; library callers keep their own signal handling)
+    drained = {"hit": False}
+    old_handler = None
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
+        def _on_term(signum, _frame):
+            drained["hit"] = True
+            pool.drain_intake()
+        try:
+            old_handler = signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            old_handler = None
+    try:
+        pool.start()
+        for job in jobs:
+            job.done.wait()
+            metrics.bump_batch_set_done()
+            if job.status == "ok":
+                # quarantined-in-worker sets may carry partial output,
+                # exactly like the serial runner writing directly into
+                # out_fp when the exception interrupts it
+                out_fp.write(job.result.get("text", ""))
+                try:
+                    # stream per-set: a consumer (or the drain test)
+                    # sees each set as it completes, not at close
+                    out_fp.flush()
+                except (AttributeError, OSError):
+                    pass
+                if job.result.get("quarantined"):
+                    stats["quarantined"] += 1
+                    _archive_job(job, abpt, "quarantined")
+                else:
+                    _archive_job(job, abpt, "ok")
+            elif job.status in ("poison", "timeout"):
+                stats["quarantined"] += 1
+                _archive_job(job, abpt, job.status)
+            elif job.status == "cancelled":
+                stats["cancelled"] = stats.get("cancelled", 0) + 1
+            else:  # "error": an unclassified worker failure is a real bug
+                raise PoolWorkerError(
+                    f"pool worker failed on {job.label!r}: {job.error}")
+            # emitted and archived: release the set's output text now —
+            # holding every result until close would grow parent RSS with
+            # the whole batch's output while each worker stays in budget
+            job.result = {}
+    finally:
+        pool.close(graceful=True)
+        if in_main and old_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, old_handler)
+            except (ValueError, OSError):
+                pass
+    if drained["hit"]:
+        print(f"[abpoa_tpu::pool] SIGTERM drain: "
+              f"{stats.get('cancelled', 0)} queued sets cancelled, "
+              "in-flight sets finished, completed output emitted.",
+              file=sys.stderr)
+    return stats

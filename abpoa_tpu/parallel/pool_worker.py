@@ -1,0 +1,67 @@
+"""Pool worker entry point: ``python -m abpoa_tpu.parallel.pool_worker``.
+
+One long-lived worker process of the supervised pool (parallel/pool.py).
+Length-prefixed pickle frames over stdin/stdout:
+
+    parent -> worker   {"params", "label"}                      (init, once)
+    worker -> parent   ("ready", pid)
+    parent -> worker   ("job", id, kind, payload, spec, kill)   per job
+    worker -> parent   ("hb", id, rss_bytes)                    while running
+    worker -> parent   ("ok", id, result) | ("err", id, message)
+    parent -> worker   None                                     (shutdown)
+
+The real stdout fd is reserved for the protocol: it is dup'd away at
+startup and fd 1 is pointed at stderr, so a stray library print (or an
+XLA banner) can never corrupt a frame. The heartbeat thread and the
+result path share one write lock — frames are atomic on the pipe.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+
+def main() -> int:
+    # keep the protocol pipe, route any other fd-1 writer to stderr
+    proto_out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = os.fdopen(1, "w")
+    inp = sys.stdin.buffer
+
+    from abpoa_tpu.parallel import pool as P
+    init = P.read_frame(inp)
+    P.worker_init(init)
+    wlock = threading.Lock()
+    with wlock:
+        P.write_frame(proto_out, ("ready", os.getpid()))
+    while True:
+        try:
+            msg = P.read_frame(inp)
+        except EOFError:
+            return 0
+        if msg is None:
+            return 0
+        _tag, job_id, kind, payload, spec, kill_kind = msg
+        stop = threading.Event()
+        hb = threading.Thread(target=P.heartbeat_loop,
+                              args=(proto_out, wlock, job_id, stop),
+                              daemon=True, name="abpoa-pool-heartbeat")
+        hb.start()
+        try:
+            frame = P.worker_run_job(job_id, kind, payload, spec, kill_kind)
+        except Exception as e:  # noqa: BLE001 — serialized for the parent,
+            # which re-raises it as PoolWorkerError (real bugs propagate)
+            import traceback
+            frame = ("err", job_id,
+                     f"{type(e).__name__}: {e}\n"
+                     f"{traceback.format_exc(limit=20)}")
+        finally:
+            stop.set()
+            hb.join(timeout=2.0)
+        with wlock:
+            P.write_frame(proto_out, frame)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

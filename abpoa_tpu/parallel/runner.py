@@ -186,11 +186,21 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
     stats = {"sets": len(files), "quarantined": 0}
     if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
         return stats  # mirror msa_from_file: nothing to emit or compute
+    lock = _lockstep_ok(abpt)
+    if not lock and devices is None and len(files) > 1:
+        # CPU-default multi-process set pool (--workers N /
+        # ABPOA_TPU_WORKERS, auto = one worker per core): lockstep loses
+        # throughput on CPU hosts (ROUND8_NOTES.md), so multi-set runs
+        # scale with supervised worker PROCESSES instead — which also
+        # buys crash containment and hard-kill deadlines (pool.py)
+        from .pool import resolve_workers, run_pool_batch
+        n_workers = resolve_workers(abpt, len(files))
+        if n_workers > 1:
+            return run_pool_batch(files, abpt, out_fp, n_workers)
     # live batch-progress gauges: `abpoa-tpu top` shows sets done / total
     # while the -l run executes (the exporter flusher publishes them)
     _metrics.publish_batch_progress(0, total=len(files))
     _mark_set_done = _metrics.bump_batch_set_done
-    lock = _lockstep_ok(abpt)
     if devices is None:
         if lock or abpt.device in ("jax", "tpu", "pallas"):
             # probe BEFORE jax.devices(): a wedged accelerator tunnel hangs

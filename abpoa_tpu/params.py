@@ -120,6 +120,12 @@ class Params:
     # "off" force it (see parallel.lockstep_enabled, CLI --lockstep)
     lockstep: str = "auto"
 
+    # supervised worker-process count for `-l` multi-set runs (CLI
+    # --workers, env ABPOA_TPU_WORKERS): 0 = auto (one per core on
+    # multicore CPU hosts, 1 under lockstep/accelerator), 1 = in-process
+    # serial, N = pool of N spawned engines (parallel/pool.py)
+    workers: int = 0
+
     # derived (set by finalize)
     mat: np.ndarray = field(default=None, repr=False)  # type: ignore[assignment]
     max_mat: int = 0

@@ -28,6 +28,27 @@ Kinds and where they fire:
                     as a non-zero ``apg_align`` return
 - ``poison_set``    set ingestion: raises PoisonedSetError, exercising the
                     per-set quarantine path
+- ``worker_kill``   process pool (parallel/pool.py): the worker process a
+                    job lands on SIGKILLs itself at job start — the
+                    supervisor must contain the death, requeue the job
+                    exactly once, and keep the batch alive
+- ``worker_sigsegv`` process pool: the worker raises SIGSEGV against
+                    itself (what a native-kernel crash looks like to the
+                    supervisor: death by signal, no Python cleanup)
+
+The two ``worker_*`` kinds fire from the pool SUPERVISOR, not from
+`pre_dispatch`: the parent consumes the shot budget centrally and tags the
+doomed job's dispatch frame, so ``worker_sigsegv:2`` means two firings
+across the whole pool run (bound to one job and its retry) — the same
+count semantics a single process would give — instead of every spawned
+worker re-arming its own budget from the environment.
+
+For the same reason the pool brokers ALL count-limited kinds across its
+worker processes: `lease()` hands the remaining budget of a kind to one
+in-flight job at a time, the worker arms exactly that lease
+(`configure()`), and `refund()` returns whatever the job did not consume.
+Unlimited kinds are simply forwarded — every worker firing them matches
+single-process behavior already.
 
 Everything here is inert when disarmed: the hot-path check is one global
 boolean (`_ANY`).
@@ -35,6 +56,7 @@ boolean (`_ANY`).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -61,12 +83,19 @@ class InjectedHang(InjectedFault):
 
 
 KINDS = ("compile_fail", "oom", "hang", "garbage", "native_crash",
-         "poison_set")
+         "poison_set", "worker_kill", "worker_sigsegv")
+
+# fired by the pool supervisor via lease(), never by pre_dispatch
+WORKER_KINDS = ("worker_kill", "worker_sigsegv")
 
 # kind -> remaining shots (-1 = unlimited); absent = disarmed
 _SPEC: Dict[str, int] = {}
 _ANY = False
 _CONFIGURED = False
+# serializes every _SPEC read-modify-write: fire() runs on serve handler
+# threads while the pool supervisor lease()s/refund()s the same budget —
+# without one lock a ':1' spec can fire twice (or lose its shot)
+_LOCK = threading.Lock()
 
 
 def configure(spec: Optional[str] = None) -> None:
@@ -76,7 +105,7 @@ def configure(spec: Optional[str] = None) -> None:
     global _ANY, _CONFIGURED
     if spec is None:
         spec = os.environ.get("ABPOA_TPU_INJECT", "")
-    _SPEC.clear()
+    parsed = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -85,9 +114,12 @@ def configure(spec: Optional[str] = None) -> None:
         if kind not in KINDS:
             raise ValueError(f"unknown fault-injection kind: {kind!r} "
                              f"(known: {', '.join(KINDS)})")
-        _SPEC[kind] = int(cnt) if cnt else -1
-    _ANY = bool(_SPEC)
-    _CONFIGURED = True
+        parsed[kind] = int(cnt) if cnt else -1
+    with _LOCK:
+        _SPEC.clear()
+        _SPEC.update(parsed)
+        _ANY = bool(_SPEC)
+        _CONFIGURED = True
 
 
 def reset() -> None:
@@ -118,14 +150,59 @@ def fire(kind: str) -> bool:
         configure(None)
     if not _ANY:
         return False
-    left = _SPEC.get(kind, 0)
-    if left == 0:
-        return False
-    if left > 0:
-        _SPEC[kind] = left - 1
+    with _LOCK:
+        left = _SPEC.get(kind, 0)
+        if left == 0:
+            return False
+        if left > 0:
+            _SPEC[kind] = left - 1
     from ..obs import count
     count(f"inject.{kind}")
     return True
+
+
+def snapshot() -> Dict[str, int]:
+    """Effective spec as {kind: remaining} (-1 = unlimited). The pool
+    supervisor reads this — programmatic `configure()` arms never reach
+    os.environ, so forwarding the env var alone would miss them."""
+    _ensure_configured()
+    with _LOCK:
+        return dict(_SPEC)
+
+
+def lease(kind: str, n: int = -1) -> int:
+    """Consume up to `n` shots of a count-limited `kind` (-1 = all that
+    remain) WITHOUT firing: the pool supervisor leases the budget to one
+    job, whose worker process does the actual (counted) firing. Returns
+    the number leased; 0 when disarmed. An UNLIMITED budget grants `n`
+    without decrementing (for `n` >= 0 — the worker-kill kinds lease one
+    shot per dispatch, so a bare ``worker_kill`` kills every job's
+    worker rather than silently doing nothing); a refund against an
+    unlimited budget is a no-op."""
+    _ensure_configured()
+    with _LOCK:
+        left = _SPEC.get(kind, 0)
+        if left == -1:
+            return max(0, n)
+        if left <= 0:
+            return 0
+        take = left if n < 0 else min(left, n)
+        _SPEC[kind] = left - take
+        return take
+
+
+def refund(kind: str, n: int) -> None:
+    """Return unconsumed leased shots to the central budget (the job
+    completed having fired fewer than it held)."""
+    if n <= 0:
+        return
+    _ensure_configured()
+    global _ANY
+    with _LOCK:
+        left = _SPEC.get(kind, 0)
+        if left >= 0:
+            _SPEC[kind] = left + n
+            _ANY = True
 
 
 def hang_seconds() -> float:

@@ -33,8 +33,72 @@ class DispatchTimeout(RuntimeError):
 # successfully-degraded run into rc=-11. At exit, grant stragglers a
 # bounded grace to finish; a truly wedged thread is abandoned for real
 # after the grace — by then all output and the exit status are flushed.
+#
+# Abandonment is an unbounded leak (each wedged thread pins its stack and
+# whatever device handle it blocks on), so it is bounded two ways:
+# `abpoa_watchdog_abandoned_threads` gauges the live leak for the fleet
+# exporter, a stderr warning fires past ABPOA_TPU_WATCHDOG_ABANDON_MAX —
+# and dispatches running inside a process-pool worker never abandon at
+# all: the pool supervisor SIGKILLs the whole worker process on deadline
+# expiry (parallel/pool.py), which reclaims thread, stack and device
+# handle in one stroke.
 _ABANDONED: list = []
 _EXIT_GRACE_S = float(os.environ.get("ABPOA_TPU_WATCHDOG_EXIT_GRACE_S", "15"))
+_WARNED_LEAK = False
+
+
+def abandon_max() -> int:
+    """Abandoned-thread count past which the leak warning fires."""
+    return int(os.environ.get("ABPOA_TPU_WATCHDOG_ABANDON_MAX", "8"))
+
+
+def abandoned_count() -> int:
+    """Live abandoned watchdog threads (finished stragglers drop out)."""
+    return sum(1 for t in _ABANDONED if t.is_alive())
+
+
+def in_pool_worker() -> bool:
+    """Is this process a pool worker (parallel/pool_worker.py)? Set by the
+    supervisor in the worker's environment; the hard-kill deadline it
+    enforces from outside replaces thread abandonment here."""
+    return os.environ.get("ABPOA_TPU_POOL_WORKER") == "1"
+
+
+def _publish_abandoned(reg) -> None:
+    """Render-time republish: the gauge must track the LIVE count back
+    down when stragglers finish, not freeze at the high-water mark the
+    last abandonment wrote."""
+    reg.gauge(
+        "abpoa_watchdog_abandoned_threads",
+        "Live abandoned watchdog worker threads (deadline expired, "
+        "dispatch still running)").set(abandoned_count())
+
+
+def _note_abandoned(t: threading.Thread) -> None:
+    global _WARNED_LEAK
+    _ABANDONED.append(t)
+    n = abandoned_count()
+    from ..obs import metrics
+    if metrics.enabled():
+        # _ABANDONED is process-lifetime state, so the collector is
+        # global (survives registry resets); it re-derives the gauge at
+        # every exposition render
+        metrics.register_global_collector(_publish_abandoned)
+        metrics.registry().gauge(
+            "abpoa_watchdog_abandoned_threads",
+            "Live abandoned watchdog worker threads (deadline expired, "
+            "dispatch still running)").set(n)
+    if n > abandon_max() and not _WARNED_LEAK:
+        _WARNED_LEAK = True
+        import sys
+        from ..obs import count
+        count("watchdog.abandon_warnings")
+        print(f"Warning: {n} abandoned watchdog threads exceed "
+              f"ABPOA_TPU_WATCHDOG_ABANDON_MAX={abandon_max()} — the "
+              "process is leaking wedged dispatch threads; route batch "
+              "work through the process pool (--workers N), whose "
+              "deadline is a hard worker SIGKILL instead of an "
+              "abandonment.", file=sys.stderr)
 
 
 def _drain_abandoned() -> None:
@@ -69,6 +133,12 @@ def supervision_needed(backend: str) -> bool:
         return False
     if os.environ.get("ABPOA_TPU_WATCHDOG_FORCE") == "1":
         return True
+    if in_pool_worker():
+        # pool-routed dispatches take the hard-kill path: the supervisor
+        # SIGKILLs this whole process past the job deadline, so a thread
+        # worker here would only add the abandonment leak the pool exists
+        # to remove (and the ~2x off-main-thread XLA:CPU compile tax)
+        return False
     from .inject import any_armed
     if any_armed():
         return True
@@ -112,7 +182,7 @@ def call_with_deadline(fn: Callable, deadline_s: float = None,
         from ..obs import count
         count("watchdog.timeouts")
         count("watchdog.abandoned_threads")
-        _ABANDONED.append(t)
+        _note_abandoned(t)
         raise DispatchTimeout(
             f"{label}: no result within {deadline_s:.1f}s watchdog deadline "
             "(wedged device dispatch?)")

@@ -25,6 +25,15 @@ abandons the executing thread — a wedged alignment never wedges the
 worker, which moves on to the next request. Every terminal disposition
 publishes `abpoa_serve_requests_total{status}` + the request-latency
 sketch and appends one archive record for `abpoa-tpu slo`.
+
+With ``--pool-workers N`` (ABPOA_TPU_SERVE_POOL) requests execute in N
+supervised worker PROCESSES instead (parallel/pool.py): the per-request
+deadline becomes a hard worker SIGKILL (no abandoned-thread leak), a
+native SIGSEGV/OOM costs one request's process — the supervisor respawns
+it warm from the persistent XLA cache — and a request that crashes its
+worker twice is quarantined as a poison job. /healthz grows a `pool`
+block (live workers, pids, restarts/kills/requeues) so operators can
+watch containment work.
 """
 from __future__ import annotations
 
@@ -57,6 +66,12 @@ def drain_grace_s() -> float:
 def max_body_bytes() -> int:
     return int(float(os.environ.get("ABPOA_TPU_SERVE_MAX_BODY_MB", "32"))
                * 1e6)
+
+
+def spawn_ready_grace_s() -> float:
+    """How long start() waits for pool workers' ready handshakes before
+    admitting anyway (jobs queue safely against a still-spawning pool)."""
+    return float(os.environ.get("ABPOA_TPU_SERVE_POOL_READY_S", "120"))
 
 
 def _test_delay_s() -> float:
@@ -99,13 +114,24 @@ class AlignServer:
 
     def __init__(self, abpt: Params, host: str = "127.0.0.1", port: int = 0,
                  workers: int = 2, queue_depth: Optional[int] = None,
-                 deadline_s: Optional[float] = None) -> None:
+                 deadline_s: Optional[float] = None,
+                 pool_workers: Optional[int] = None) -> None:
         if not abpt._finalized:
             abpt = abpt.finalize()
         self.abpt = abpt
         self.deadline_s = (deadline_s if deadline_s is not None
                            else default_deadline_s())
         self.admission = AdmissionController(abpt, max_depth=queue_depth)
+        # process-isolated execution backend (parallel/pool.py): requests
+        # run in supervised worker PROCESSES — a native crash or wedged
+        # dispatch costs one job's process, never a serve worker thread.
+        # 0 = execute in-thread as before (ABPOA_TPU_SERVE_POOL /
+        # --pool-workers opt in).
+        if pool_workers is None:
+            pool_workers = int(os.environ.get("ABPOA_TPU_SERVE_POOL",
+                                              "0") or 0)
+        self._pool_n = max(0, pool_workers)
+        self._pool = None
         self.draining = threading.Event()
         self.ready = threading.Event()
         self._stats: Dict[str, int] = {}
@@ -167,6 +193,17 @@ class AlignServer:
             else:
                 print("[abpoa-tpu serve] Warning: JAX backend probe timed "
                       "out; serving on the host engine.", file=sys.stderr)
+        if self._pool_n:
+            # spawned AFTER the warm so fresh workers (including every
+            # respawn after a kill) load the rungs the warm just wrote to
+            # the persistent XLA cache instead of recompiling
+            from ..parallel import WorkerPool
+            self._pool = WorkerPool(self._pool_n, self.abpt, label="serve")
+            self._pool.start()
+            self._pool.wait_ready(timeout=spawn_ready_grace_s())
+            # coalesced lockstep groups stay in-process; the pool is the
+            # per-request containment backend (CPU hosts foremost)
+            self._lockstep = False
         for i in range(self._n_workers):
             t = threading.Thread(target=self._worker_loop, daemon=True,
                                  name=f"abpoa-serve-worker-{i}")
@@ -186,6 +223,10 @@ class AlignServer:
         ok = self.admission.wait_drained(timeout)
         for t in self._workers:
             t.join(timeout=2.0)
+        if self._pool is not None:
+            # queue already drained above: workers finish their frame,
+            # answer the shutdown handshake, and exit clean
+            self._pool.close(graceful=True)
         return ok
 
     def shutdown_http(self) -> None:
@@ -227,10 +268,15 @@ class AlignServer:
         degraded = {b: st["to"] for b, st in dict(breaker().open).items()}
         status = ("draining" if self.draining.is_set()
                   else "degraded" if degraded else "ok")
-        return {"status": status, "degraded": degraded or None,
-                "queue_depth": depth, "inflight": inflight,
-                "served": self.stats(), "device": self.abpt.device,
-                "uptime_s": round(time.time() - self.t_start, 1)}
+        out = {"status": status, "degraded": degraded or None,
+               "queue_depth": depth, "inflight": inflight,
+               "served": self.stats(), "device": self.abpt.device,
+               "uptime_s": round(time.time() - self.t_start, 1)}
+        if self._pool is not None:
+            # worker pids included so an operator (or the smoke harness)
+            # can kill a worker and watch the supervisor respawn it
+            out["pool"] = self._pool.snapshot()
+        return out
 
     # ---------------------------------------------------------- execution
     def _worker_loop(self) -> None:
@@ -306,6 +352,9 @@ class AlignServer:
             if job.finish("timeout", error="request deadline expired"):
                 self.account(job, "timeout")
             return
+        if self._pool is not None:
+            self._finish_single_pool(job, remaining)
+            return
         try:
             body = call_with_deadline(
                 lambda: self._run_single(job, abpt),
@@ -330,6 +379,49 @@ class AlignServer:
             print(f"[abpoa-tpu serve] {job.label} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             if job.finish("error", error=f"{type(e).__name__}: {e}"):
+                self.account(job, "error")
+
+    def _finish_single_pool(self, job: Job, remaining: float) -> None:
+        """Execute ONE job in the process pool. The pool's deadline is a
+        hard worker SIGKILL (504, thread AND process reclaimed — the
+        in-thread path could only abandon); a crashed worker retries the
+        job once on a fresh process, a second crash quarantines it as a
+        poison job (500 + structured fault record). Worker-side
+        quarantine exceptions keep their 400 contract."""
+        pj = self._pool.submit("records", (list(job.records),),
+                               label=job.label, deadline_s=remaining,
+                               est_bytes=job.est_bytes)
+        pj.done.wait()
+        if pj.status == "ok":
+            q = pj.result.get("quarantined")
+            if q:
+                # the fault record was written in the worker (run_records)
+                # and already merged into this report — recording here
+                # would double-count the same event against the SLO
+                # fault budget
+                if job.finish("poisoned", error=f"{q[0]}: {q[1]}"):
+                    self.account(job, "poisoned")
+            else:
+                if job.finish("ok", body=pj.result.get("text", "")):
+                    self.account(job, "ok")
+        elif pj.status == "timeout":
+            # the pool already recorded this event (worker_killed at the
+            # deadline SIGKILL, or job_deadline when the budget expired
+            # before dispatch) — a serve-side record would double-count
+            # one 504 against the SLO fault budget, unlike the in-thread
+            # path whose single request_timeout record is the only one
+            if job.finish("timeout", error="request deadline expired "
+                                           "(worker hard-killed)"):
+                self.account(job, "timeout")
+        elif pj.status == "poison":
+            # fault record already written by the pool supervisor
+            if job.finish("error", error=f"poison job quarantined: "
+                                         f"{pj.error}"):
+                self.account(job, "error")
+        else:  # "error" / "cancelled"
+            obs.record_fault("request_error", detail=str(pj.error)[:300],
+                             action="rejected_500")
+            if job.finish("error", error=pj.error or "pool unavailable"):
                 self.account(job, "error")
 
     def _run_single(self, job: Job, abpt: Params) -> str:
@@ -585,6 +677,13 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--workers", type=int,
                     default=min(4, os.cpu_count() or 1),
                     help="alignment worker threads [%(default)s]")
+    ap.add_argument("--pool-workers", type=int, default=None, metavar="N",
+                    help="execute requests in N supervised worker "
+                         "PROCESSES (parallel/pool.py): crash "
+                         "containment, hard-kill deadlines instead of "
+                         "thread abandonment, poison-job quarantine; "
+                         "0 = in-thread execution "
+                         "[ABPOA_TPU_SERVE_POOL or 0]")
     ap.add_argument("--queue-depth", type=int, default=None,
                     help="admission queue bound "
                          "[ABPOA_TPU_SERVE_QUEUE or 64]")
@@ -657,7 +756,8 @@ def serve_main(argv) -> int:
         server = AlignServer(abpt, host=args.host, port=args.port,
                              workers=args.workers,
                              queue_depth=args.queue_depth,
-                             deadline_s=args.deadline_s)
+                             deadline_s=args.deadline_s,
+                             pool_workers=args.pool_workers)
     except OSError as e:
         print(f"Error: cannot bind {args.host}:{args.port}: {e}",
               file=sys.stderr)
@@ -679,9 +779,11 @@ def serve_main(argv) -> int:
         # authoritative here (--port 0 picks ephemeral) — printed BEFORE
         # the AOT warm, which can take minutes on a cold cache; /readyz
         # answers 503 until warm completes
+        pool_note = (f", pool={server._pool_n} procs" if server._pool_n
+                     else "")
         print(f"[abpoa-tpu serve] listening on "
               f"http://{server.host}:{server.port} "
-              f"(workers={args.workers}, queue="
+              f"(workers={args.workers}{pool_note}, queue="
               f"{server.admission._max_depth}, "
               f"deadline={server.deadline_s:.0f}s, device={abpt.device})",
               file=sys.stderr, flush=True)

@@ -18,6 +18,11 @@ os.environ["ABPOA_TPU_PROBE_CACHE_TTL"] = "0"
 # archive (~/.cache/abpoa_tpu/reports); archive tests opt back in with an
 # explicit ABPOA_TPU_ARCHIVE_DIR + ABPOA_TPU_ARCHIVE=1 (tests/test_metrics.py)
 os.environ.setdefault("ABPOA_TPU_ARCHIVE", "0")
+# the suite's many multi-set run_batch calls stay on the in-process serial
+# path: the process pool (parallel/pool.py) spawns interpreter children per
+# worker, which the 870s tier-1 budget cannot afford as a side effect.
+# Pool tests opt back in with an explicit Params.workers / --workers N.
+os.environ.setdefault("ABPOA_TPU_WORKERS", "1")
 # persistent compilation cache: the device-path tests are dominated by XLA
 # compile time (minutes per pallas-interpret variant); cache across runs and
 # across the subprocess-isolated children, which inherit this env

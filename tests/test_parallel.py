@@ -1,4 +1,5 @@
-"""Multi-device tests on the 8-way virtual CPU mesh (see conftest)."""
+"""Multi-device tests on the 8-way virtual CPU mesh (see conftest), and
+the supervised process pool (parallel/pool.py, ISSUE 13)."""
 import io
 import os
 
@@ -190,3 +191,267 @@ def test_run_batch_8_sets_matches_sequential(tmp_path):
         abpt2.batch_index = i + 1
         msa_from_file(Abpoa(), abpt2, fn, want)
     assert out.getvalue() == want.getvalue()
+
+
+# --------------------------------------------------------------------------- #
+# supervised process pool (parallel/pool.py, ISSUE 13)                        #
+# --------------------------------------------------------------------------- #
+
+def _pool_params(workers):
+    from abpoa_tpu.params import Params
+    abpt = Params()
+    abpt.device = "numpy"   # jax-import-free workers: ~0.5s spawns
+    abpt.workers = workers
+    return abpt.finalize()
+
+
+def _sim_files(tmp_path, n, ref_len=120):
+    import subprocess
+    import sys
+    files = []
+    for s in range(n):
+        p = str(tmp_path / f"pool{s}.fa")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "make_sim.py"),
+             "--ref-len", str(ref_len), "--n-reads", "4", "--err", "0.1",
+             "--seed", str(700 + s), "--out", p], check=True)
+        files.append(p)
+    return files
+
+
+def test_pool_restart_backoff_schedule():
+    """The respawn ladder: immediate first spawn, then base * 2^(n-1)
+    capped at 30 s for consecutive deaths."""
+    from abpoa_tpu.parallel.pool import restart_backoff_s
+    os.environ["ABPOA_TPU_POOL_BACKOFF_S"] = "0.5"
+    try:
+        assert restart_backoff_s(0) == 0.0
+        assert restart_backoff_s(1) == 0.5
+        assert restart_backoff_s(2) == 1.0
+        assert restart_backoff_s(3) == 2.0
+        assert restart_backoff_s(10) == 30.0  # cap
+    finally:
+        del os.environ["ABPOA_TPU_POOL_BACKOFF_S"]
+
+
+def test_pool_resolve_workers_precedence(monkeypatch):
+    from abpoa_tpu.parallel import resolve_workers
+    abpt = _pool_params(0)
+    monkeypatch.setenv("ABPOA_TPU_WORKERS", "3")
+    assert resolve_workers(abpt, 8) == 3
+    assert resolve_workers(abpt, 2) == 2      # never more than sets
+    abpt.workers = 5                          # explicit Params wins
+    assert resolve_workers(abpt, 8) == 5
+    monkeypatch.setenv("ABPOA_TPU_WORKERS", "auto")
+    abpt.workers = 0
+    assert resolve_workers(abpt, 1) == 1      # single set: no pool
+
+
+def test_pool_output_byte_identical_across_w(tmp_path):
+    """Pool output (W=4) byte-matches the in-process serial runner (W=1)
+    over mixed-length sets — the ordering + containment layer must be
+    invisible in the bytes."""
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 4)
+    outs = {}
+    for w in (1, 4):
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(w), out)
+        assert stats == {"sets": 4, "quarantined": 0}
+        outs[w] = out.getvalue()
+    assert outs[1] == outs[4]
+    assert outs[1].count(">Consensus_sequence") == 4
+
+
+def test_pool_double_crash_quarantines_poison_job(tmp_path):
+    """worker_sigsegv:2 -> one job crashes its worker twice -> poison
+    quarantine; healthy sets complete; exactly one requeue."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 3)
+    obs.start_run()
+    rz.inject.configure("worker_sigsegv:2")
+    try:
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(3), out)
+    finally:
+        rz.inject.reset()
+    assert stats["quarantined"] == 1
+    assert out.getvalue().count(">Consensus_sequence") == 2
+    c = obs.report().counters
+    assert c.get("inject.worker_sigsegv") == 2
+    assert c.get("pool.requeues") == 1
+    assert c.get("pool.poison_jobs") == 1
+    assert c.get("pool.worker_crashes") == 2
+    kinds = {r["kind"] for r in obs.report().faults}
+    assert "poison_job" in kinds and "worker_crash" in kinds
+
+
+def test_pool_requeue_exactly_once_and_archive_idempotent(
+        tmp_path, monkeypatch):
+    """worker_kill:1 -> the killed job retries once on a fresh worker and
+    SUCCEEDS; the archive carries exactly ONE record per job (terminal
+    status only — requeues never double-append)."""
+    import json
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "reports"))
+    files = _sim_files(tmp_path, 3)
+    obs.start_run()
+    rz.inject.configure("worker_kill:1")
+    try:
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(3), out)
+    finally:
+        rz.inject.reset()
+    assert stats["quarantined"] == 0
+    assert out.getvalue().count(">Consensus_sequence") == 3
+    c = obs.report().counters
+    assert c.get("inject.worker_kill") == 1
+    assert c.get("pool.requeues") == 1
+    assert c.get("pool.restarts", 0) >= 1
+    assert not c.get("pool.poison_jobs")
+    recs = []
+    with open(tmp_path / "reports" / "reports.jsonl") as fp:
+        for ln in fp:
+            rec = json.loads(ln)
+            if rec.get("kind") == "pool_job":
+                recs.append(rec)
+    assert len(recs) == 3, recs
+    assert sorted(r["label"] for r in recs) == sorted(files)
+    assert all(r["status"] == "ok" for r in recs)
+    # the requeued job records BOTH attempts in its single record
+    assert max(r["attempts"] for r in recs) == 2
+
+
+def test_pool_deadline_hard_kill_is_terminal(tmp_path, monkeypatch):
+    """A job that outlives its deadline is SIGKILLed and quarantined
+    WITHOUT a retry (the budget is spent — watchdog semantics), while
+    fast jobs complete."""
+    from abpoa_tpu import obs
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 2)
+    monkeypatch.setenv("ABPOA_TPU_POOL_DELAY_S", "5")
+    monkeypatch.setenv("ABPOA_TPU_POOL_DEADLINE_S", "1.0")
+    obs.start_run()
+    out = io.StringIO()
+    stats = run_batch(files, _pool_params(2), out)
+    assert stats["quarantined"] == 2
+    c = obs.report().counters
+    assert c.get("pool.kills") == 2
+    assert not c.get("pool.requeues")
+    kinds = {r["kind"] for r in obs.report().faults}
+    assert "worker_killed" in kinds
+
+
+def test_pool_rss_budget_kill(tmp_path, monkeypatch):
+    """A worker whose resident set exceeds the RSS budget is hard-killed
+    on its heartbeat; the job retries once (fresh worker, same breach)
+    and lands in poison quarantine."""
+    from abpoa_tpu import obs
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 1)
+    monkeypatch.setenv("ABPOA_TPU_POOL_RSS_MB", "8")   # below interpreter RSS
+    monkeypatch.setenv("ABPOA_TPU_POOL_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("ABPOA_TPU_POOL_DELAY_S", "3")  # outlive a heartbeat
+    obs.start_run()
+    out = io.StringIO()
+    # workers=2 with one set still builds a 1-slot pool; force 2 jobs
+    stats = run_batch(files * 2, _pool_params(2), out)
+    c = obs.report().counters
+    assert stats["quarantined"] == 2, (stats, c)
+    assert c.get("pool.kills", 0) >= 2
+    kinds = {r["kind"] for r in obs.report().faults}
+    assert "worker_killed" in kinds and "poison_job" in kinds
+
+
+def test_pool_graceful_drain_on_sigterm(tmp_path):
+    """SIGTERM mid-batch: queued jobs cancel, in-flight jobs finish,
+    completed output is emitted in order, rc stays 0."""
+    import signal
+    import subprocess
+    import sys
+    import time
+    files = _sim_files(tmp_path, 4)
+    lst = tmp_path / "list.txt"
+    lst.write_text("".join(f + "\n" for f in files))
+    out = tmp_path / "out.fa"
+    env = dict(os.environ, ABPOA_TPU_POOL_DELAY_S="3.0",
+               ABPOA_TPU_WORKERS="2")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "-l", str(lst),
+         "--device", "numpy", "-o", str(out)],
+        env=env, stderr=subprocess.PIPE, text=True)
+    # SIGTERM once the FIRST set's output landed: at that point sets 2-3
+    # are in flight (2 workers x 3s delay) and set 4 is still queued —
+    # deterministic mid-batch, however slow the host is
+    t0 = time.time()
+    while time.time() - t0 < 40:
+        if out.exists() and ">Consensus_sequence" in out.read_text():
+            break
+        if proc.poll() is not None:
+            raise AssertionError("batch finished before the drain signal")
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    t0 = time.time()
+    rc = proc.wait(timeout=30)
+    stderr = proc.stderr.read()
+    assert rc == 0, stderr
+    assert time.time() - t0 < 15
+    assert "SIGTERM drain" in stderr, stderr
+    n = out.read_text().count(">Consensus_sequence")
+    assert 1 <= n <= 4, (n, stderr)
+
+
+def test_pool_worker_report_merges_to_parent(tmp_path):
+    """Counters and fault records produced INSIDE workers surface in the
+    parent run report (the one --report/--metrics/archive read)."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 3)
+    obs.start_run()
+    rz.inject.configure("poison_set:1")
+    try:
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(3), out)
+    finally:
+        rz.inject.reset()
+    # the leased shot fired in exactly ONE worker (not re-armed per
+    # process) and came back as parent-report state
+    assert stats["quarantined"] == 1
+    c = obs.report().counters
+    assert c.get("inject.poison_set") == 1
+    assert c.get("quarantine.sets") == 1
+    kinds = {r["kind"] for r in obs.report().faults}
+    assert "poisoned_set" in kinds
+
+
+def test_pool_kill_shots_rebind_after_poison(tmp_path):
+    """worker_sigsegv:3 — the bound victim absorbs two shots and is
+    poisoned; the THIRD shot rebinds to another job, which survives its
+    single crash via the exactly-once requeue (shots never strand).
+    Single-worker pool: with parallel slots the later jobs could finish
+    before the rebind, which is healthy but not what this test pins."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    files = _sim_files(tmp_path, 3)
+    obs.start_run()
+    rz.inject.configure("worker_sigsegv:3")
+    try:
+        out = io.StringIO()
+        from abpoa_tpu.parallel.pool import run_pool_batch
+        stats = run_pool_batch(files, _pool_params(1), out, 1)
+    finally:
+        rz.inject.reset()
+    assert stats["quarantined"] == 1
+    assert out.getvalue().count(">Consensus_sequence") == 2
+    c = obs.report().counters
+    assert c.get("inject.worker_sigsegv") == 3, c
+    assert c.get("pool.poison_jobs") == 1, c
+    assert c.get("pool.requeues") == 2, c

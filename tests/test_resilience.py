@@ -637,3 +637,32 @@ def test_overhead_guard_resilience_disarmed():
     finally:
         rz.set_enabled(True)
     assert on <= off * 1.25 + 0.05, (on, off)
+
+
+def test_watchdog_abandon_gauge_and_pool_hard_kill_routing(monkeypatch,
+                                                           capsys):
+    """ISSUE-13 satellite: the abandoned-thread leak is gauged
+    (abpoa_watchdog_abandoned_threads) and warns past
+    ABPOA_TPU_WATCHDOG_ABANDON_MAX; inside a pool worker thread
+    supervision is OFF — the supervisor's SIGKILL is the deadline."""
+    from abpoa_tpu.obs import metrics
+    from abpoa_tpu.resilience import watchdog as wd
+
+    # pool workers never thread-supervise (hard kill replaces abandon) —
+    # unless explicitly forced
+    monkeypatch.setenv("ABPOA_TPU_POOL_WORKER", "1")
+    assert wd.supervision_needed("jax") is False
+    monkeypatch.setenv("ABPOA_TPU_WATCHDOG_FORCE", "1")
+    assert wd.supervision_needed("jax") is True
+    monkeypatch.delenv("ABPOA_TPU_WATCHDOG_FORCE")
+    monkeypatch.delenv("ABPOA_TPU_POOL_WORKER")
+
+    monkeypatch.setenv("ABPOA_TPU_WATCHDOG_ABANDON_MAX", "0")
+    before = wd.abandoned_count()
+    with pytest.raises(wd.DispatchTimeout):
+        wd.call_with_deadline(lambda: time.sleep(0.8), deadline_s=0.05,
+                              label="abandon-gauge-test")
+    g = metrics.registry().get("abpoa_watchdog_abandoned_threads")
+    assert g is not None and g.value() >= before + 1
+    assert wd._WARNED_LEAK is True
+    assert "abandoned watchdog threads" in capsys.readouterr().err

@@ -430,3 +430,46 @@ def test_top_renders_serve_panel():
     assert "queue 3" in frame and "inflight 2" in frame
     assert "ok=182" in frame and "rejected=24" in frame
     assert "p99 130.00" in frame
+
+
+def test_pool_backend_contains_worker_kill(monkeypatch):
+    """--pool-workers execution backend (ISSUE 13): requests run in
+    supervised processes; a SIGKILLed worker costs one requeue, never the
+    service — and a hard deadline is a worker SIGKILL answering 504."""
+    monkeypatch.setenv("ABPOA_TPU_SERVE_DELAY_S", "0.8")
+    srv = _start_server(workers=2, pool_workers=1)
+    base = f"http://{srv.host}:{srv.port}"
+    body = open(TEST_FA, "rb").read()
+    try:
+        # healthy request through the pool: byte-identical
+        code, got, _h = _post(base, body)
+        assert code == 200 and got == _oracle_bytes()
+        pool = _get_json(base, "/healthz")[1]["pool"]
+        assert pool["workers"] == 1 and pool["jobs"] == 1
+
+        # kill the worker MID-request: the job requeues on a fresh
+        # worker and still answers 200 byte-identical
+        res = {}
+
+        def post_bg():
+            res["code"], res["body"], _ = _post(base, body, timeout=60)
+
+        t = threading.Thread(target=post_bg)
+        t.start()
+        time.sleep(0.4)  # inside the delay shim window
+        pid = _get_json(base, "/healthz")[1]["pool"]["pids"][0]
+        os.kill(pid, signal.SIGKILL)
+        t.join()
+        assert res["code"] == 200 and res["body"] == _oracle_bytes()
+        pool = _get_json(base, "/healthz")[1]["pool"]
+        assert pool["requeues"] == 1 and pool["workers"] == 1
+
+        # a too-tight deadline is a hard worker SIGKILL -> 504
+        code, _b, _h = _post(base, body,
+                             headers={"X-Abpoa-Deadline-S": "0.3"},
+                             timeout=30)
+        assert code == 504
+        pool = _get_json(base, "/healthz")[1]["pool"]
+        assert pool["kills"] == 1
+    finally:
+        assert srv.stop()

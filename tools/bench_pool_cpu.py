@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Process-pool throughput on the CPU host: the ISSUE-13 measurement.
+
+Runs N copies of the sim2k read set through the `-l` batch path with
+``--workers W`` for W in {1, 2, 4, 8} — W=1 is the in-process serial
+runner, W>1 the supervised process pool — each in a fresh CLI subprocess
+(fair cold-ish comparison; the native engine needs no XLA warm). Judged
+against the same 0.7*W rule the round-8 lockstep measurement failed
+(BENCH_lockstep_cpu.json): pool speedup at W must reach 0.7*W on a host
+with >= W cores, or the shortfall gets analyzed in PERF.md with the
+bottleneck named.
+
+Also times one worker spawn (interpreter + package import + ready
+handshake) so the per-worker tax is a measured number, not a guess: with
+sim2k's per-set wall in the tens of milliseconds, spawn cost dominates
+short batches and the JSON says exactly by how much.
+
+    python tools/bench_pool_cpu.py [--sets N] [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+SIM2K = os.path.join(REPO, "tests", "data", "sim2k.fa")
+sys.path.insert(0, REPO)
+
+WORKERS = (1, 2, 4, 8)
+RULE = 0.7
+
+
+def cpu_count() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def measure_spawn_s(device: str) -> float:
+    """One worker's spawn tax: process + import + ready handshake."""
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel import WorkerPool
+    abpt = Params()
+    abpt.device = device
+    abpt.finalize()
+    pool = WorkerPool(1, abpt, label="bench-spawn")
+    t0 = time.perf_counter()
+    pool.start()
+    pool.wait_ready(timeout=120)
+    dt = time.perf_counter() - t0
+    pool.close(graceful=True)
+    return dt
+
+
+def run_config(lst: str, w: int, device: str) -> float:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ABPOA_TPU_SKIP_PROBE="1",
+               ABPOA_TPU_ARCHIVE="0", ABPOA_TPU_WORKERS=str(w))
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", "-l", lst,
+         "--device", device, "-o", os.devnull],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"W={w} rc={proc.returncode}:\n"
+                           f"{proc.stderr[-2000:]}")
+    return dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sets", type=int, default=16,
+                    help="sim2k copies in the batch [%(default)s]")
+    ap.add_argument("--device", default="native",
+                    help="per-worker engine [%(default)s]")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_pool_cpu.json"))
+    args = ap.parse_args(argv)
+
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as fp:
+        lst = fp.name
+        for _ in range(args.sets):
+            fp.write(SIM2K + "\n")
+    cpus = cpu_count()
+    spawn_s = measure_spawn_s(args.device)
+    print(f"[bench-pool] host: {cpus} cpu(s); worker spawn tax "
+          f"{spawn_s:.2f}s ({args.device} engine)", flush=True)
+
+    rows = []
+    base = None
+    for w in WORKERS:
+        wall = run_config(lst, w, args.device)
+        if base is None:
+            base = wall
+        speedup = base / wall
+        target = RULE * min(w, cpus)
+        rows.append({
+            "workers": w,
+            "wall_s": round(wall, 3),
+            "sets_per_s": round(args.sets / wall, 3),
+            "speedup_vs_serial": round(speedup, 3),
+            "rule_target": round(target, 2),
+            "passes_rule": bool(speedup >= target),
+        })
+        print(f"[bench-pool] W={w}: {wall:.2f}s "
+              f"({args.sets / wall:.2f} sets/s, {speedup:.2f}x, "
+              f"rule needs >= {target:.2f} on this host)", flush=True)
+    os.unlink(lst)
+
+    w4 = next(r for r in rows if r["workers"] == 4)
+    result = {
+        "bench": "pool_cpu",
+        "workload": f"sim2k x {args.sets} sets",
+        "device": args.device,
+        "host_cpus": cpus,
+        "worker_spawn_s": round(spawn_s, 3),
+        "rule": f"speedup >= {RULE}*min(W, cpus)",
+        "rows": rows,
+        "w4_passes": w4["passes_rule"],
+        "note": ("pool parallelism needs physical cores: on a host with "
+                 "fewer cores than W the rule target is clamped to "
+                 "0.7*cpus, and the remaining gap is the measured "
+                 "spawn + frame-protocol tax (see PERF.md round 13)"),
+    }
+    with open(args.out, "w") as fp:
+        json.dump(result, fp, indent=2)
+        fp.write("\n")
+    print(f"[bench-pool] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

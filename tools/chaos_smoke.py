@@ -20,6 +20,13 @@ are process-global). The device backend is `jax` pinned to CPU, so this
 needs no accelerator; the injectors fire before any kernel runs, so no XLA
 compile is paid for the fail-shaped runs.
 
+The ``worker_kill:1`` / ``worker_sigsegv:2`` scenarios drive the
+supervised process pool (ISSUE 13): a 4-set ``--workers 4`` batch whose
+injected worker deaths must leave the supervisor alive (rc=0), requeue
+the killed job exactly once, quarantine a twice-crashing job as poison,
+keep every healthy set byte-identical to the numpy oracle, and export
+lint-clean pool metric families.
+
     python tools/chaos_smoke.py [--keep] [--only KIND]
 """
 from __future__ import annotations
@@ -36,22 +43,57 @@ REPO = os.path.dirname(TOOLS)
 DATA = os.path.join(REPO, "tests", "data")
 sys.path.insert(0, REPO)
 
-# injector -> (expected fault kind, expect breaker-degraded block)
+# injector -> (expected fault kind, expect breaker-degraded block).
+# The non-pool scenarios run --device jax, which auto-pooling excludes
+# (resolve_workers), so they take the in-process path as before — but
+# every assertion below ALSO holds with ABPOA_TPU_WORKERS forced >1
+# (worker report deltas merge into the parent report; verified in the
+# PR-13 round).
 SCENARIOS = {
     "compile_fail": ("compile_fail", True),
     "oom": ("oom", True),
     "hang": ("hang", True),
     "garbage": ("garbage_output", False),
     "poison_set:1": ("poisoned_set", False),
+    # process-pool supervision (ISSUE 13): the injector kills the worker
+    # a job landed on; the supervisor must survive, requeue the job
+    # exactly once, and quarantine a twice-crashing job as poison —
+    # rc=0 with every healthy set's output byte-identical to the numpy
+    # oracle
+    "worker_kill:1": ("worker_crash", False),
+    "worker_sigsegv:2": ("poison_job", False),
 }
+
+POOL_SCENARIOS = ("worker_kill", "worker_sigsegv")
+POOL_SETS = 4
+
+
+def pool_oracle_chunks(n: int) -> list:
+    """Per-set numpy-oracle output chunks (batch_index changes the
+    consensus header, so each set index has its own expected bytes)."""
+    import io
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+    chunks = []
+    for i in range(n):
+        abpt = Params()
+        abpt.device = "numpy"
+        abpt.finalize()
+        abpt.batch_index = i + 1
+        buf = io.StringIO()
+        msa_from_file(Abpoa(), abpt, os.path.join(DATA, "test.fa"), buf)
+        chunks.append(buf.getvalue())
+    return chunks
 
 
 def run_one(spec: str, tmp: str, verbose: bool) -> list:
     """Run the multi-set workload with `spec` armed; return failure strings."""
     name = spec.split(":")[0]
+    pool = name in POOL_SCENARIOS
+    n_sets = POOL_SETS if pool else 3
     lst = os.path.join(tmp, f"list_{name}.txt")
     with open(lst, "w") as fp:
-        for _ in range(3):
+        for _ in range(n_sets):
             fp.write(os.path.join(DATA, "test.fa") + "\n")
     out = os.path.join(tmp, f"out_{name}.fa")
     rpt = os.path.join(tmp, f"report_{name}.json")
@@ -71,23 +113,32 @@ def run_one(spec: str, tmp: str, verbose: bool) -> list:
         # deadline is sized to never do
         env["ABPOA_TPU_INJECT_HANG_S"] = "1.0"
         env["ABPOA_TPU_WATCHDOG_S"] = "0.5"
-    proc = subprocess.run(
-        [sys.executable, "-m", "abpoa_tpu.cli", "-l", lst, "--device", "jax",
-         "-o", out, "--report", rpt, "--metrics", mtx],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    argv = [sys.executable, "-m", "abpoa_tpu.cli", "-l", lst,
+            "-o", out, "--report", rpt, "--metrics", mtx]
+    # the device-dispatch injectors need a device backend; the worker-kill
+    # scenarios kill processes, not kernels — the numpy engine keeps the
+    # 4-worker spawns jax-import-free and the oracle trivially identical
+    argv += (["--device", "numpy", "--workers", str(POOL_SETS)] if pool
+             else ["--device", "jax"])
+    proc = subprocess.run(argv, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
     failures = []
     expected_kind, expect_degraded = SCENARIOS[spec]
     if proc.returncode != 0:
         return [f"{name}: rc={proc.returncode} (must complete degraded, "
                 f"rc=0)\nstderr:\n{proc.stderr[-2000:]}"]
-    n_expected = 2 if name == "poison_set" else 3
+    n_expected = {"poison_set": 2, "worker_sigsegv": POOL_SETS - 1,
+                  "worker_kill": POOL_SETS}.get(name, 3)
     with open(out) as fp:
-        n_cons = fp.read().count(">Consensus_sequence")
+        out_text = fp.read()
+    n_cons = out_text.count(">Consensus_sequence")
     if n_cons != n_expected:
         failures.append(f"{name}: {n_cons} consensus sequences, "
                         f"expected {n_expected}")
     with open(rpt) as fp:
         rep = json.load(fp)
+    if pool:
+        failures.extend(_check_pool_scenario(name, spec, out_text, rep))
     kinds = (rep.get("faults") or {}).get("kinds") or {}
     if not kinds.get(expected_kind):
         failures.append(f"{name}: no '{expected_kind}' faults record "
@@ -118,9 +169,67 @@ def run_one(spec: str, tmp: str, verbose: bool) -> list:
             failures.append(f"{name}: abpoa_breaker_open{{backend=\"jax\"}} "
                             f"= {gauge}, expected 1 after the breaker "
                             "tripped")
+    if pool:
+        # the pool families must exist (materialized at 0) and lint clean
+        # in the exposition — "zero kills" is a readable 0, not absence
+        for fam in ("abpoa_pool_workers", "abpoa_pool_restarts_total",
+                    "abpoa_pool_kills_total", "abpoa_pool_requeues_total",
+                    "abpoa_pool_poison_jobs_total"):
+            if M.sample_value(samples, fam) is None:
+                failures.append(f"{name}: {fam} missing from the "
+                                "exposition")
+        v = M.sample_value(samples, "abpoa_pool_restarts_total")
+        if not v:
+            failures.append(f"{name}: abpoa_pool_restarts_total = {v}, "
+                            "expected >= 1 after the worker death")
     if verbose:
         print(f"[chaos-smoke] {name}: rc=0, {n_cons} consensus, "
               f"faults={kinds}, degraded={sorted(rep.get('degraded') or {})}")
+    return failures
+
+
+def _check_pool_scenario(name: str, spec: str, out_text: str,
+                         rep: dict) -> list:
+    """The supervised-pool contract: supervisor rc=0 (checked by the
+    caller), exactly one requeue per killed job, a twice-crashing job
+    quarantined as poison, and every healthy set's output byte-identical
+    to the numpy oracle."""
+    failures = []
+    counters = rep.get("counters") or {}
+    shots = int(spec.split(":")[1])
+    if counters.get(f"inject.{name}") != shots:
+        failures.append(f"{name}: injector fired "
+                        f"{counters.get(f'inject.{name}')} times, "
+                        f"expected {shots}")
+    if counters.get("pool.requeues") != 1:
+        failures.append(f"{name}: pool.requeues = "
+                        f"{counters.get('pool.requeues')} — the killed "
+                        "job must requeue exactly once")
+    if not counters.get("pool.restarts"):
+        failures.append(f"{name}: no pool.restarts recorded")
+    if name == "worker_kill":
+        if counters.get("pool.poison_jobs"):
+            failures.append(f"{name}: a once-killed job was quarantined "
+                            "(the retry should have succeeded)")
+    else:  # worker_sigsegv:2 — the bound job crashes twice -> poison
+        if counters.get("pool.poison_jobs") != 1:
+            failures.append(f"{name}: pool.poison_jobs = "
+                            f"{counters.get('pool.poison_jobs')}, "
+                            "expected exactly 1")
+        if counters.get("quarantine.sets") != 1:
+            failures.append(f"{name}: quarantine.sets = "
+                            f"{counters.get('quarantine.sets')}, "
+                            "expected 1 (rc stayed 0 for the healthy "
+                            "sets)")
+    # healthy output byte-identical to the numpy oracle: the surviving
+    # per-set chunks, in file order (a poisoned set's chunk is absent)
+    chunks = pool_oracle_chunks(POOL_SETS)
+    candidates = ["".join(chunks)] + [
+        "".join(c for j, c in enumerate(chunks) if j != i)
+        for i in range(POOL_SETS)]
+    if out_text not in candidates:
+        failures.append(f"{name}: output is not byte-identical to the "
+                        "numpy oracle for any surviving-set combination")
     return failures
 
 

@@ -22,7 +22,18 @@ The server must:
   `abpoa-tpu slo` passes;
 - drain clean on SIGTERM: in-flight finished, metrics flushed, exit 0.
 
+A second phase (ISSUE 13, skip with --no-pool-phase) starts a fresh
+server with ``--pool-workers 2`` — requests executing in supervised
+worker PROCESSES — and SIGKILLs a live worker mid-soak. The service must
+keep answering 200s byte-identical to the numpy oracle (the killed job
+requeues once on a fresh worker; the only acceptable 5xx is a designed
+504), the supervisor must respawn the worker, and the restarted worker
+must be WARM: zero true XLA compiles inside workers for the whole phase
+(`abpoa_pool_worker_xla_compiles_total` == 0 — every worker compile is a
+persistent-cache load).
+
     python tools/serve_smoke.py [--keep] [--requests N] [--no-inject]
+                                [--no-pool-phase]
 """
 from __future__ import annotations
 
@@ -101,6 +112,154 @@ def _drain_stderr(proc, sink: list) -> None:
         sink.append(line)
 
 
+def run_pool_kill_phase(base_env: dict, payload_path: str, oracles: set,
+                        tmp: str) -> list:
+    """ISSUE-13 phase: serve with --pool-workers 2, SIGKILL a worker
+    mid-soak, assert containment + warm restart. Returns failure strings."""
+    import threading
+    failures: list = []
+    metrics_path = os.path.join(tmp, "metrics_pool.prom")
+    env = dict(base_env)
+    # two kill sources at once: the worker_sigsegv injector crashes ONE
+    # request's worker twice (a poison job: quarantined, answered 500,
+    # supervisor lives), and an external SIGKILL lands mid-soak (the
+    # killed job requeues once and still answers 200)
+    env["ABPOA_TPU_INJECT"] = "worker_sigsegv:2"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port", "0",
+         "--device", "jax", "--workers", "2", "--pool-workers", "2",
+         "--warm", "quick", "--metrics", metrics_path],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        port = read_port(proc)
+        base = f"http://127.0.0.1:{port}"
+        stderr_tail: list = []
+        threading.Thread(target=_drain_stderr, args=(proc, stderr_tail),
+                         daemon=True).start()
+        wait_ready(base, proc)
+
+        from loadgen import LoadGen
+        with open(payload_path, "rb") as fp:
+            body = fp.read()
+
+        def read_pool():
+            with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+                return json.loads(r.read()).get("pool") or {}
+
+        pool0 = read_pool()
+        if pool0.get("workers") != 2:
+            failures.append(f"pool phase: {pool0.get('workers')} workers "
+                            "ready, expected 2")
+
+        # a few warmup requests so every worker has served (their
+        # first-compile cache loads land BEFORE the kill window); the
+        # worker_sigsegv victim usually lands here too
+        gen_warm = LoadGen(base, [body], rate=5.0, n=6, timeout_s=120)
+        warm = gen_warm.run()
+        print("[serve-smoke] pool warmup:", json.dumps(warm), flush=True)
+
+        def kill_one():
+            try:
+                pids = read_pool().get("pids") or []
+                if pids:
+                    os.kill(pids[0], signal.SIGKILL)
+                    print(f"[serve-smoke] pool phase: SIGKILLed worker "
+                          f"pid {pids[0]} mid-soak", flush=True)
+            except (OSError, urllib.error.URLError) as e:
+                failures.append(f"pool phase: worker kill failed: {e}")
+
+        timer = threading.Timer(1.5, kill_one)
+        timer.start()
+        gen = LoadGen(base, [body], rate=10.0, n=60, timeout_s=120)
+        soak = gen.run()
+        timer.cancel()
+        print("[serve-smoke] pool-kill soak:", json.dumps(soak), flush=True)
+
+        if soak["errors"]:
+            failures.append(f"pool phase: {soak['errors']} transport "
+                            "errors through the worker kills")
+        pool1 = read_pool()
+        # designed 5xx only: 504s, plus exactly one 500 per quarantined
+        # poison job (the worker_sigsegv:2 victim, warmup included)
+        merged = dict(warm["status"])
+        for c, n in soak["status"].items():
+            merged[c] = merged.get(c, 0) + n
+        bad_5xx = {c: n for c, n in merged.items()
+                   if c.startswith("5") and c != "504"}
+        n_500 = merged.get("500", 0)
+        bad_5xx.pop("500", None)
+        if bad_5xx:
+            failures.append(f"pool phase: undesigned 5xx through the "
+                            f"worker kills: {bad_5xx}")
+        if n_500 != pool1.get("poison_jobs", 0):
+            failures.append(f"pool phase: {n_500} 500s vs "
+                            f"{pool1.get('poison_jobs')} poison jobs — "
+                            "every 500 must be a quarantined poison job")
+        if pool1.get("poison_jobs") != 1:
+            failures.append(f"pool phase: poison_jobs = "
+                            f"{pool1.get('poison_jobs')}, expected the "
+                            "worker_sigsegv:2 victim quarantined exactly "
+                            "once")
+        if pool1.get("requeues", 0) < 1:
+            failures.append("pool phase: no requeue recorded (sigsegv "
+                            "retry + SIGKILLed in-flight job)")
+        # every 200 body — warmup included (those hit the coldest and the
+        # sigsegv-respawned workers) — must match the numpy oracle
+        bodies = list(gen_warm.bodies_ok) + list(gen.bodies_ok)
+        bad = sum(1 for b in bodies if b not in oracles)
+        if bad:
+            failures.append(f"pool phase: {bad}/{len(bodies)} healthy "
+                            "responses NOT byte-identical to the numpy "
+                            "oracle")
+        if not pool1.get("restarts"):
+            failures.append("pool phase: supervisor recorded no restart "
+                            f"after the kills ({pool1})")
+        if pool1.get("workers") != 2:
+            failures.append(f"pool phase: {pool1.get('workers')} workers "
+                            "after the kills, expected 2 (respawn)")
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            expo = r.read().decode()
+        from abpoa_tpu.obs import metrics as M
+        lint = M.lint_exposition(expo)
+        if lint:
+            failures.append(f"pool phase: exposition lint: {lint[:3]}")
+        samples, _types = M.parse_exposition(expo)
+        for fam in ("abpoa_pool_workers", "abpoa_pool_restarts_total",
+                    "abpoa_pool_kills_total"):
+            if M.sample_value(samples, fam) is None:
+                failures.append(f"pool phase: {fam} missing from "
+                                "exposition")
+        # the warm-restart claim: zero true XLA compiles inside workers
+        # across the WHOLE phase — the respawned worker loaded every rung
+        # from the persistent cache the startup warm filled. The family
+        # is materialized at pool start, so absence is a broken pipeline,
+        # not a vacuous pass.
+        burst = M.sample_value(samples,
+                               "abpoa_pool_worker_xla_compiles_total")
+        if burst is None:
+            failures.append("pool phase: abpoa_pool_worker_xla_compiles_"
+                            "total missing — the warm-restart claim is "
+                            "unverifiable")
+        elif burst:
+            failures.append(f"pool phase: {burst:.0f} true XLA compiles "
+                            "inside workers — the restarted worker was "
+                            "NOT warm from the persistent cache")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+        if rc != 0:
+            failures.append(f"pool phase: SIGTERM drain exited rc={rc}")
+        if "Traceback" in "".join(stderr_tail):
+            failures.append("pool phase: server stderr carries a "
+                            "Traceback:\n" + "".join(stderr_tail)[-2000:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=240,
@@ -110,6 +269,8 @@ def main(argv=None) -> int:
                     help="keep the work dir for inspection")
     ap.add_argument("--no-inject", action="store_true",
                     help="skip the fault injectors (pure overload soak)")
+    ap.add_argument("--no-pool-phase", action="store_true",
+                    help="skip the --pool-workers worker-kill phase")
     args = ap.parse_args(argv)
     tmp = tempfile.mkdtemp(prefix="abpoa_serve_smoke_")
     payload = os.path.join(DATA, "test.fa")
@@ -291,6 +452,9 @@ def main(argv=None) -> int:
         if args.keep:
             print(f"[serve-smoke] work dir kept: {tmp}")
 
+    if not args.no_pool_phase:
+        failures.extend(run_pool_kill_phase(env, payload, oracles, tmp))
+
     if failures:
         for f in failures:
             print(f"[serve-smoke] FAIL: {f}", file=sys.stderr)
@@ -298,7 +462,10 @@ def main(argv=None) -> int:
     print(f"[serve-smoke] PASS: {args.requests} soak requests at 2x "
           "overload with every injector armed — shed as 429s, poison as "
           "400s, deadlines as 504s, healthy bytes oracle-identical, "
-          "breaker tripped AND reclosed, drain rc=0, slo ok")
+          "breaker tripped AND reclosed, drain rc=0, slo ok"
+          + ("" if args.no_pool_phase else
+             "; pool phase: mid-soak worker SIGKILL contained, requeued, "
+             "respawned warm (0 worker XLA compiles)"))
     return 0
 
 
