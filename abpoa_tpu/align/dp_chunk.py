@@ -1,0 +1,417 @@
+"""DP-only batched chunk: the lockstep dispatch with fusion OFF the batch axis.
+
+ROUND8_NOTES.md measured K=4 all-device lockstep 1.37x SLOWER than serial on
+CPU hosts: the vmapped fusion scatters (and the vmapped while_loop's
+per-iteration full-plane selects) multiplied trip counts instead of widening
+lanes. Fusion is host-cheap (~24 ms/read measured) and sequential-per-read
+anyway — so the split lockstep driver (parallel/lockstep.py) keeps each
+set's graph on the HOST and batches only what vectorizes: the banded DP
+scan + device backtrack, one vmapped dispatch per read round across K sets.
+
+This module owns that dispatch:
+
+- `run_dp_chunk`: jit(vmap) of fused_loop's `_dp_banded` (static_rows mode —
+  a fori_loop, because a vmapped while_loop's batched cond wraps every carry
+  in a per-iteration select: measured ~200x at K=4 on XLA:CPU) plus best-cell
+  selection and `_backtrack_w`, returning one packed int32 row per set.
+- `build_lockstep_tables`: numpy mirror of fused_loop._build_tables for a
+  host POAGraph — same masks, same band seeding, same remain semantics, so
+  the batched DP sees exactly the tables the fused loop would have built.
+- `cigar_from_ops`: the reference-order cigar rebuild (the same walk as
+  jax_backend._result_from_packed), feeding the host graph's add_alignment.
+
+Compile ladder: entry "run_dp_chunk" with axes R (row rung, GEOM_64 like the
+window batch), Qp/W (shared chunk buckets), P (degree slots, pow2 floor 8)
+and K (set axis, pow2); `abpoa-tpu warm` precompiles the anchors.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..compile import registry
+from ..compile.buckets import bucket as _bucket
+from ..compile.buckets import bucket_pow2 as _bucket_pow2
+from ..params import Params
+from .fused_loop import _backtrack_w, _dp_banded
+from .oracle import (INT16_MIN, INT32_MIN, dp_inf_min, int16_score_limit,
+                     max_score_bound)
+from .result import AlignResult
+from ..cigar import push_cigar
+
+# degree-slot floor: POA in/out-degrees sit at <= 8 for realistic data, and a
+# fixed floor keeps the (R, K) compile grid deterministic for the warmer
+P_FLOOR = 8
+
+
+# --------------------------------------------------------------------------- #
+# device entry point                                                          #
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.jit, static_argnames=(
+    "gap_mode", "W", "max_ops", "plane16", "extend", "zdrop_on", "local",
+    "gap_on_right", "put_gap_at_end"))
+def run_dp_chunk(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+                 remain_rows, mpl0, mpr0, qp, query, n_rows, qlen, w,
+                 remain_end, dp_end0, mat, inf_min,
+                 o1, e1, oe1, o2, e2, oe2, zdrop,
+                 gap_mode: int, W: int, max_ops: int, plane16: bool,
+                 extend: bool, zdrop_on: bool, local: bool,
+                 gap_on_right: bool, put_gap_at_end: bool):
+    """One read round for K sets: banded DP + backtrack, no graph update.
+
+    Leading axis of every table/scalar array is the set axis K. Returns a
+    (K, 10 + 2*max_ops) int32 pack per set:
+    [n_ops, fin_i, fin_j, n_aln, n_match, bt_err, overflow, best_score,
+     best_i, best_j] + ops.flat — the host rebuilds the cigar and fuses.
+    """
+
+    def one(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+            remain_rows, mpl0, mpr0, qp, query, n_rows, qlen, w,
+            remain_end, dp_end0):
+        (Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, _ml, _mr, overflow,
+         bs, bi, bj) = _dp_banded(
+            base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
+            remain_rows, mpl0, mpr0, qp, n_rows,
+            qlen, w, remain_end, inf_min, dp_end0,
+            o1, e1, oe1, o2, e2, oe2,
+            gap_mode=gap_mode, W=W, plane16=plane16, extend=extend,
+            zdrop_on=zdrop_on, zdrop=zdrop, local=local, static_rows=True)
+        if extend or local:
+            best_i, best_j, best_sc = bi, bj, bs
+        else:
+            # global best over the sink's pred rows at their band ends
+            # (mirror of fused_loop.align_strand's selection)
+            sink_rows = pre_idx[n_rows - 1]
+            sink_msk = pre_msk[n_rows - 1]
+            ends = jnp.minimum(qlen, dp_end[sink_rows])
+            kidx = jnp.clip(ends - dp_beg[sink_rows], 0, W - 1)
+            vals = jnp.where(sink_msk & (ends - dp_beg[sink_rows] >= 0)
+                             & (ends - dp_beg[sink_rows] < W),
+                             jnp.take_along_axis(Hb[sink_rows],
+                                                 kidx[:, None],
+                                                 axis=1)[:, 0],
+                             inf_min.astype(Hb.dtype))
+            kk = jnp.argmax(vals)
+            best_i = sink_rows[kk]
+            best_j = ends[kk]
+            best_sc = vals[kk].astype(jnp.int32)
+        ops, n_ops, fin_i, fin_j, n_aln, n_match, bt_err = _backtrack_w(
+            Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, pre_idx, pre_msk,
+            base_r, query, mat, best_i, best_j,
+            e1, oe1, e2, oe2, inf_min,
+            gap_mode=gap_mode, gap_on_right=gap_on_right,
+            put_gap_at_end=put_gap_at_end, max_ops=max_ops, local=local)
+        head = jnp.stack([n_ops, fin_i, fin_j, n_aln, n_match,
+                          bt_err.astype(jnp.int32),
+                          overflow.astype(jnp.int32),
+                          best_sc, best_i.astype(jnp.int32),
+                          best_j.astype(jnp.int32)])
+        return jnp.concatenate([head, ops.reshape(-1)])
+
+    return jax.vmap(one)(base_r, pre_idx, pre_msk, out_idx, out_msk,
+                         row_active, remain_rows, mpl0, mpr0, qp, query,
+                         n_rows, qlen, w, remain_end, dp_end0)
+
+
+# --------------------------------------------------------------------------- #
+# host-side table builder (numpy mirror of fused_loop._build_tables)          #
+# --------------------------------------------------------------------------- #
+
+def build_lockstep_tables(g, abpt: Params, query: np.ndarray,
+                          Qp: int) -> dict:
+    """Kernel tables for one whole-graph global alignment of `query`
+    against host POAGraph `g`, at the graph's exact row count (the
+    dispatcher pads every set to the round's shared R/P rungs).
+
+    Mirrors fused_loop._build_tables mask for mask (pre rows > 0 and < n,
+    out rows > 0 and < n-1, row_active (0, n-1), mpl0 = n everywhere except
+    source 0 / source-outs 1) so the batched DP computes exactly what the
+    fused loop would. Any valid topological order yields identical results
+    (fused_loop module docstring) — the host graph's reference BFS order is
+    used directly.
+    """
+    if not g.is_topological_sorted:
+        g.topological_sort(abpt)
+    n = g.node_n
+    qlen = len(query)
+    nodes = g.nodes
+    idx2nid = g.index_to_node_id
+    n2i = g.node_id_to_index
+    remain = g.node_id_to_max_remain
+
+    pre_lists = []
+    out_lists = []
+    d_max = 1
+    for i in range(n):
+        nd = nodes[int(idx2nid[i])]
+        pl = [int(n2i[p]) for p in nd.in_ids] if 0 < i < n else []
+        ol = [int(n2i[o]) for o in nd.out_ids] if 0 < i < n - 1 else []
+        pre_lists.append(pl)
+        out_lists.append(ol)
+        d_max = max(d_max, len(pl), len(ol))
+    P = max(P_FLOOR, _bucket_pow2(d_max))
+    base_r = np.zeros(n, np.int32)
+    pre_idx = np.zeros((n, P), np.int32)
+    pre_msk = np.zeros((n, P), bool)
+    out_idx = np.zeros((n, P), np.int32)
+    out_msk = np.zeros((n, P), bool)
+    row_active = np.zeros(n, bool)
+    remain_rows = np.zeros(n, np.int32)
+    for i in range(n):
+        nd = nodes[int(idx2nid[i])]
+        base_r[i] = nd.base
+        remain_rows[i] = remain[int(idx2nid[i])]
+        pl = pre_lists[i]
+        pre_idx[i, :len(pl)] = pl
+        pre_msk[i, :len(pl)] = True
+        ol = out_lists[i]
+        out_idx[i, :len(ol)] = ol
+        out_msk[i, :len(ol)] = True
+        row_active[i] = 0 < i < n - 1
+    mpl0 = np.full(n, n, np.int32)
+    mpl0[0] = 0
+    mpr0 = np.zeros(n, np.int32)
+    src_rows = [int(n2i[o]) for o in nodes[C.SRC_NODE_ID].out_ids]
+    mpl0[src_rows] = 1
+    mpr0[src_rows] = 1
+
+    # band scalars: the python-float w of the per-read host path (the
+    # oracle's arithmetic), not the fused loop's traced f32 twin
+    w = abpt.wb + int(abpt.wf * qlen)
+    remain_end = int(remain[C.SINK_NODE_ID])
+    local_m = abpt.align_mode == C.LOCAL_MODE
+    if local_m:
+        dp_end0 = qlen
+    else:
+        r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
+        dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)
+
+    qp = np.zeros((abpt.m, Qp), np.int32)
+    query_pad = np.zeros(Qp, np.int32)
+    if qlen:
+        qp[:, 1: qlen + 1] = abpt.mat[:, query]
+        query_pad[:qlen] = query
+    return dict(base_r=base_r, pre_idx=pre_idx, pre_msk=pre_msk,
+                out_idx=out_idx, out_msk=out_msk, row_active=row_active,
+                remain_rows=remain_rows, mpl0=mpl0, mpr0=mpr0, qp=qp,
+                query=query_pad, n_rows=n, qlen=qlen, w=w,
+                remain_end=remain_end, dp_end0=dp_end0)
+
+
+def chunk_plane16(abpt: Params, qlen: int, n: int) -> bool:
+    """int16 planes while the score bound allows — the host-side twin of
+    the fused loop's in-loop ERR_PROMOTE check (oracle.max_score_bound)."""
+    limit = int16_score_limit(abpt)
+    ln = max(qlen, n)
+    bound = max(qlen * int(abpt.max_mat),
+                ln * int(abpt.gap_ext1) + int(abpt.gap_open1))
+    return bound <= limit
+
+
+# --------------------------------------------------------------------------- #
+# packed-output unpack: cigar rebuild + AlignResult                           #
+# --------------------------------------------------------------------------- #
+
+HEAD_LEN = 10
+
+
+def result_from_chunk(abpt: Params, packed: np.ndarray, tables: dict,
+                      idx2nid) -> Tuple[AlignResult, dict]:
+    """One set's packed row -> (AlignResult with cigar, status flags).
+
+    The cigar walk is jax_backend._result_from_packed's reference-order
+    rebuild; flags report band overflow (grow W and retry the round) and
+    backtrack divergence (set falls back to the sequential path). The op
+    count is derived from the row length, so it cannot drift from the
+    max_ops dispatch_dp_chunk sized the row with.
+    """
+    max_ops = (len(packed) - HEAD_LEN) // 2
+    (n_ops, fin_i, fin_j, n_aln, n_match, bt_err, overflow, best_score,
+     best_i, best_j) = [int(x) for x in packed[:HEAD_LEN]]
+    flags = {"overflow": bool(overflow), "bt_err": bool(bt_err)}
+    res = AlignResult()
+    res.best_score = best_score
+    if overflow or bt_err:
+        return res, flags
+    qlen = tables["qlen"]
+    ops = packed[HEAD_LEN:].reshape(max_ops, 2)
+    res.n_aln_bases = n_aln
+    res.n_matched_bases = n_match
+    cigar: list = []
+    if best_j < qlen:
+        push_cigar(cigar, C.CINS, qlen - best_j, -1, qlen - 1)
+    jj = best_j
+    for ti in range(n_ops):
+        opc, dpi = int(ops[ti, 0]), int(ops[ti, 1])
+        nid = int(idx2nid[dpi])
+        if opc == 0:
+            push_cigar(cigar, C.CMATCH, 1, nid, jj - 1)
+            jj -= 1
+        elif opc == 1:
+            push_cigar(cigar, C.CDEL, 1, nid, jj - 1)
+        else:
+            push_cigar(cigar, C.CINS, 1, nid, jj - 1)
+            jj -= 1
+    if fin_j > 0:
+        push_cigar(cigar, C.CINS, fin_j, -1, fin_j - 1)
+    if not abpt.rev_cigar:
+        cigar.reverse()
+    res.cigar = cigar
+    res.node_e = int(idx2nid[best_i]) if best_i < len(idx2nid) else -1
+    res.query_e = best_j - 1
+    return res, flags
+
+
+# --------------------------------------------------------------------------- #
+# dispatch helper: pad/stack K table dicts and run one chunk                  #
+# --------------------------------------------------------------------------- #
+
+_TABLE_KEYS = ("base_r", "pre_idx", "pre_msk", "out_idx", "out_msk",
+               "row_active", "remain_rows", "mpl0", "mpr0", "qp", "query")
+_SCALAR_KEYS = ("n_rows", "qlen", "w", "remain_end", "dp_end0")
+
+
+def chunk_statics(abpt: Params, W: int, max_ops: int, plane16: bool) -> dict:
+    extend_m = abpt.align_mode == C.EXTEND_MODE
+    return dict(gap_mode=abpt.gap_mode, W=W, max_ops=max_ops,
+                plane16=plane16,
+                extend=extend_m, zdrop_on=extend_m and abpt.zdrop > 0,
+                local=abpt.align_mode == C.LOCAL_MODE,
+                gap_on_right=bool(abpt.put_gap_on_right),
+                put_gap_at_end=bool(abpt.put_gap_at_end))
+
+
+def _pad_tables(t: dict, R: int, P: int) -> dict:
+    """Pad one set's exact-size tables to the round's shared (R, P) rungs.
+    Padding rows are inactive/unmasked; their band seeds are never read."""
+    out = dict(t)
+    n = t["base_r"].shape[0]
+    p0 = t["pre_idx"].shape[1]
+    for key in ("base_r", "row_active", "remain_rows", "mpl0", "mpr0"):
+        a = t[key]
+        out[key] = np.concatenate([a, np.zeros(R - n, a.dtype)]) \
+            if R > n else a
+    for key in ("pre_idx", "pre_msk", "out_idx", "out_msk"):
+        a = t[key]
+        a = np.pad(a, ((0, R - n), (0, P - p0))) if (R > n or P > p0) else a
+        out[key] = a
+    return out
+
+
+def dispatch_dp_chunk(abpt: Params, table_list: List[dict], Kb: int, R: int,
+                      P: int, Qp: int, W: int, plane16: bool) -> np.ndarray:
+    """Pad `table_list` to the shared (R, P) rungs and Kb set slots (zero
+    no-op sets), dispatch ONE run_dp_chunk, return the
+    (len(table_list), ...) packed rows. Padding slots carry
+    n_rows=2/qlen=0: the backtrack exits at (0, 0) and the row loop sees
+    every row inactive."""
+    max_ops = R + Qp + 8
+    k_real = len(table_list)
+    padded = [_pad_tables(t, R, P) for t in table_list]
+    arrays = {}
+    for key in _TABLE_KEYS:
+        stacked = np.stack([t[key] for t in padded])
+        if k_real < Kb:
+            pad = np.zeros((Kb - k_real,) + stacked.shape[1:],
+                           stacked.dtype)
+            stacked = np.concatenate([stacked, pad])
+        arrays[key] = jnp.asarray(stacked)
+    scalars = {}
+    for key in _SCALAR_KEYS:
+        vec = np.asarray([t[key] for t in table_list], np.int32)
+        if k_real < Kb:
+            fill = 2 if key == "n_rows" else 0
+            vec = np.concatenate([vec, np.full(Kb - k_real, fill, np.int32)])
+        scalars[key] = jnp.asarray(vec)
+    inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+    mat = jnp.asarray(np.ascontiguousarray(abpt.mat.astype(np.int32)))
+    statics = chunk_statics(abpt, W, max_ops, plane16)
+    bucket = dict(R=R, P=P, Qp=Qp, W=W, K=Kb, plane16=plane16,
+                  gap_mode=abpt.gap_mode, align_mode=abpt.align_mode)
+    from ..obs import trace
+    with trace.span("dp_chunk", "dp", args=dict(bucket, sets=k_real)):
+        with registry.watch("run_dp_chunk", bucket):
+            packed = run_dp_chunk(
+                arrays["base_r"], arrays["pre_idx"], arrays["pre_msk"],
+                arrays["out_idx"], arrays["out_msk"], arrays["row_active"],
+                arrays["remain_rows"], arrays["mpl0"], arrays["mpr0"],
+                arrays["qp"], arrays["query"], scalars["n_rows"],
+                scalars["qlen"], scalars["w"], scalars["remain_end"],
+                scalars["dp_end0"], mat, jnp.int32(inf_min),
+                jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+                jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+                jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
+                jnp.int32(max(abpt.zdrop, 0)), **statics)
+            out = np.asarray(packed)  # sync inside the compile bracket
+    return out[:k_real]
+
+
+def plan_row_rung(n_max: int) -> int:
+    """Row rung for the largest active graph this round (GEOM_64 chain —
+    the declared R axis of the run_dp_chunk ladder entry)."""
+    return _bucket(max(n_max, 8), 64)
+
+
+def plan_degree_rung(d_max: int) -> int:
+    return max(P_FLOOR, _bucket_pow2(d_max))
+
+
+# --------------------------------------------------------------------------- #
+# compile-ladder integration: AOT warmer                                      #
+# --------------------------------------------------------------------------- #
+
+def _warm_dp_chunk(abpt: Params, anchor) -> list:
+    """Precompile the split-lockstep DP chunk for one anchor: the start row
+    rung of the anchor's qmax plus `growth` rungs of graph growth, at the
+    anchor's K rung and its repack halvings. Zero-filled no-op inputs (every
+    row inactive, qlen 0) make the dispatch cost pure compile."""
+    from ..compile.ladder import k_rung, plan_chunk_buckets, qp_rung
+    from ..obs import compile_log
+    recs = []
+    Qp = qp_rung(anchor.qmax)
+    _qp, W, _local = plan_chunk_buckets(abpt, anchor.qmax)
+    plane16 = max_score_bound(abpt, anchor.qmax, 2) <= int16_score_limit(abpt)
+    ks = []
+    k = k_rung(anchor.k or 4)
+    while k >= 1:
+        ks.append(k)
+        k //= 2
+    rungs = []
+    R = plan_row_rung(anchor.qmax + 2)
+    stop = plan_row_rung(2 * (anchor.qmax + 2) + 64)
+    for _g in range(anchor.growth + 1):
+        rungs.append(R)
+        if R >= stop:
+            break
+        R = plan_row_rung(R + 1)
+    for R in rungs:
+        for Kb in ks:
+            tables = [dict(
+                base_r=np.zeros(R, np.int32),
+                pre_idx=np.zeros((R, P_FLOOR), np.int32),
+                pre_msk=np.zeros((R, P_FLOOR), bool),
+                out_idx=np.zeros((R, P_FLOOR), np.int32),
+                out_msk=np.zeros((R, P_FLOOR), bool),
+                row_active=np.zeros(R, bool),
+                remain_rows=np.zeros(R, np.int32),
+                mpl0=np.zeros(R, np.int32), mpr0=np.zeros(R, np.int32),
+                qp=np.zeros((abpt.m, Qp), np.int32),
+                query=np.zeros(Qp, np.int32),
+                n_rows=2, qlen=0, w=0, remain_end=0, dp_end0=0)] * Kb
+            dispatch_dp_chunk(abpt, tables, Kb, R, P_FLOOR, Qp, W, plane16)
+            rr = compile_log.run_records()
+            recs.append(rr[-1] if rr and rr[-1]["fn"] == "run_dp_chunk"
+                        else {"fn": "run_dp_chunk",
+                              "bucket": dict(R=R, K=Kb, Qp=Qp, W=W)})
+    return recs
+
+
+registry.register_entry("run_dp_chunk", handle=lambda: run_dp_chunk,
+                        warmer=_warm_dp_chunk)

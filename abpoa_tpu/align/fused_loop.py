@@ -228,14 +228,15 @@ def _row0_planes(W, dp_end0, o1, e1, oe1, o2, e2, oe2, inf,
     return H0, E10, E20, F10, F20
 
 @functools.partial(jax.jit, static_argnames=("gap_mode", "W", "plane16",
-                                              "extend", "zdrop_on", "local"))
+                                              "extend", "zdrop_on", "local",
+                                              "static_rows"))
 def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                remain_rows, mpl0, mpr0, qp, n_rows,
                qlen, w, remain_end, inf_min, dp_end0,
                o1, e1, oe1, o2, e2, oe2,
                gap_mode: int, W: int, plane16: bool = False,
                extend: bool = False, zdrop_on: bool = False, zdrop=0,
-               local: bool = False):
+               local: bool = False, static_rows: bool = False):
     """Adaptive-banded DP with W-wide windowed plane storage.
 
     Row i stores plane cells for absolute columns [dp_beg[i], dp_beg[i]+W);
@@ -251,8 +252,10 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     at 0, the M lead treated as 0, and the best (leftmost, earliest-row)
     max-anywhere cell tracked in the same scalar slots.
 
-    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, mpl, mpr, band_overflow,
-    best_score, best_i, best_j).
+    Returns (H, E1, E2, F1, F2, dp_beg, dp_end, row_left, row_right,
+    band_overflow, best_score, best_i, best_j) — row_left/row_right are the
+    realized per-row band extremes (formerly the push-accumulated mpl/mpr
+    slots; band propagation is pull-based now, see the loop comment).
     """
     R = base_r.shape[0]
     P = pre_idx.shape[1]
@@ -281,8 +284,22 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     F2b = jnp.full((R, W), inf, dt).at[0].set(F20)
     dp_beg = jnp.zeros(R, jnp.int32)
     dp_end = jnp.zeros(R, jnp.int32).at[0].set(dp_end0)
-    mpl = jnp.concatenate([mpl0, jnp.zeros(1, jnp.int32)])
-    mpr = jnp.concatenate([mpr0, jnp.zeros(1, jnp.int32)])
+    # Realized band extremes per row (leftmost/rightmost max column, -1 when
+    # the row's band is empty). Band propagation is PULL-based: row i gathers
+    # its predecessors' left/right instead of rows scattering into their
+    # successors' mpl/mpr slots. The push formulation used two masked
+    # `.at[tgt].max/min` scatters per row, and XLA:CPU lowers a vmapped
+    # masked scatter to a per-element loop — measured 200x slower at K=4
+    # (ROUND8_NOTES.md); the pull gather rides the predecessor gathers the
+    # row already performs. Semantics are identical: edge (p -> i) appears
+    # in both p's out slots and i's pre slots, the source row's seed (1 on
+    # its out-edges) is precomputed into mpl0/mpr0, and a Z-drop exit stops
+    # the row loop before any successor could have pulled from the dropped
+    # row (with DP_UNROLL > 1 an unread same-block overshoot row may pull a
+    # band the push form would have suppressed — those rows are never read
+    # back; DP_UNROLL defaults to 1).
+    left_r = jnp.zeros(R, jnp.int32)
+    right_r = jnp.zeros(R, jnp.int32)
 
     n_chain_steps = max(1, (W - 1).bit_length())
 
@@ -318,6 +335,8 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
     pad_i = jnp.zeros(K, jnp.int32)
     dp_beg = jnp.concatenate([dp_beg, pad_i])
     dp_end = jnp.concatenate([dp_end, pad_i])
+    left_r = jnp.concatenate([left_r, pad_i])
+    right_r = jnp.concatenate([right_r, pad_i])
 
     def pre_window(plane, pidx, pm, pb, abs_cols, inf):
         """Gather predecessor plane cells at absolute columns (P, W).
@@ -342,8 +361,13 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         return v
 
     def body(st):
-        (i0, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow,
-         bs, bi, bj, brem, zdropped) = st
+        (i0, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, left_r, right_r,
+         overflow, bs, bi, bj, brem, zdropped) = st
+        # In while-loop mode the cond has already exited on overflow/zdrop,
+        # so this fold is a no-op; in static_rows mode (fixed trip count,
+        # see below) it predicates the remaining rows off exactly where the
+        # while loop would have stopped.
+        stopped = overflow | zdropped
         lH = []
         lE1 = []
         lE2 = []
@@ -351,9 +375,11 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         lF2 = []
         lbeg = []
         lend = []
+        lleft = []
+        lright = []
         for t in range(K):
             i = i0 + t
-            active = row_active[i]
+            active = row_active[i] & (~stopped)
             pm = pre_msk[i]
             pidx = pre_idx[i]
 
@@ -366,8 +392,23 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 pb = jnp.zeros_like(dp_beg[pidx])
             else:
                 r = qlen - (remain_rows[i] - remain_end - 1)
-                beg = jnp.maximum(0, jnp.minimum(mpl[i], r) - w)
-                end = jnp.minimum(qlen, jnp.maximum(mpr[i], r) + w)
+                # pull the predecessors' realized extremes (the push form
+                # accumulated left/right+1 into this row's mpl/mpr slots);
+                # the source row (pidx == 0) contributes via the mpl0/mpr0
+                # seed instead — it never pushed (out_msk[0] is False)
+                pull = pm & (pidx > 0)
+                pl_v = left_r[pidx]
+                pr_v = right_r[pidx]
+                for s in range(t):
+                    m_s = pidx == i0 + s
+                    pl_v = jnp.where(m_s, lleft[s], pl_v)
+                    pr_v = jnp.where(m_s, lright[s], pr_v)
+                mpl_i = jnp.minimum(mpl0[i], jnp.min(
+                    jnp.where(pull, pl_v + 1, jnp.int32(2**30))))
+                mpr_i = jnp.maximum(mpr0[i], jnp.max(
+                    jnp.where(pull, pr_v + 1, jnp.int32(-(2**30)))))
+                beg = jnp.maximum(0, jnp.minimum(mpl_i, r) - w)
+                end = jnp.minimum(qlen, jnp.maximum(mpr_i, r) + w)
                 pb = dp_beg[pidx]
                 for s in range(t):
                     pb = jnp.where(pidx == i0 + s, lbeg[s], pb)
@@ -484,12 +525,6 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 bi = jnp.where(better, i, bi)
                 bj = jnp.where(better, right, bj)
                 brem = jnp.where(better, remain_rows[i], brem)
-            if not local:  # local bypasses the band formula entirely
-                om = out_msk[i] & active & (~zdropped)
-                tgt = jnp.where(om, out_idx[i], R)
-                mpr = mpr.at[tgt].max(jnp.where(om, right + 1, -(2**30)))
-                mpl = mpl.at[tgt].min(jnp.where(om, left + 1, 2**30))
-
             # ---- local commit (inactive rows write discarded padding) ------
             lH.append(jnp.where(active, Hrow, inf))
             lE1.append(jnp.where(active, E1n, inf))
@@ -498,6 +533,12 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             lF2.append(jnp.where(active, F2n, inf))
             lbeg.append(jnp.where(active, beg, 0))
             lend.append(jnp.where(active, end, 0))
+            if local:
+                lleft.append(jnp.int32(0))
+                lright.append(jnp.int32(0))
+            else:
+                lleft.append(jnp.where(active, left, 0))
+                lright.append(jnp.where(active, right, 0))
 
         # ---- block commit: one contiguous write per buffer -----------------
         Hb = lax.dynamic_update_slice(Hb, jnp.stack(lH), (i0, 0))
@@ -509,8 +550,12 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
                 F2b = lax.dynamic_update_slice(F2b, jnp.stack(lF2), (i0, 0))
         dp_beg = lax.dynamic_update_slice(dp_beg, jnp.stack(lbeg), (i0,))
         dp_end = lax.dynamic_update_slice(dp_end, jnp.stack(lend), (i0,))
-        return (i0 + K, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-                overflow, bs, bi, bj, brem, zdropped)
+        if not local:
+            left_r = lax.dynamic_update_slice(left_r, jnp.stack(lleft), (i0,))
+            right_r = lax.dynamic_update_slice(right_r, jnp.stack(lright),
+                                               (i0,))
+        return (i0 + K, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, left_r,
+                right_r, overflow, bs, bi, bj, brem, zdropped)
 
     def cond(st):
         i = st[0]
@@ -521,14 +566,30 @@ def _dp_banded(base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
         # (backtrack starts at best_i, whose predecessors all precede it)
         return (i < n_rows - 1) & (~overflow) & (~zdropped)
 
-    st = (jnp.int32(1), Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr,
-          jnp.bool_(False), inf32, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-          jnp.bool_(False))
-    st = lax.while_loop(cond, body, st)
-    (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, mpl, mpr, overflow,
+    st = (jnp.int32(1), Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, left_r,
+          right_r, jnp.bool_(False), inf32, jnp.int32(0), jnp.int32(0),
+          jnp.int32(0), jnp.bool_(False))
+    if static_rows:
+        # Fixed trip count over every padded row (rows past n_rows-1 are
+        # inactive; rows past an overflow/Z-drop are predicated off via
+        # `stopped`). A while_loop's traced cond becomes BATCHED under vmap,
+        # and jax's batching rule then wraps every carry — including the
+        # (R, W) planes — in a per-iteration select: measured ~200x slower
+        # at K=4 on XLA:CPU. A fori_loop's cond stays unbatched, so the
+        # lockstep DP chunk (run_dp_chunk) requests this mode; the single-
+        # set fused path keeps the early-exiting while_loop.
+        n_iters = max(1, -(-(R - 2) // K))
+        st = lax.fori_loop(0, n_iters, lambda _, s: body(s), st)
+    else:
+        st = lax.while_loop(cond, body, st)
+    (_, Hb, E1b, E2b, F1b, F2b, dp_beg, dp_end, left_r, right_r, overflow,
      bs, bi, bj, _brem, _zd) = st
+    # the mpl/mpr output slots now carry each row's realized band extremes
+    # (left/right of the row max) — no fused consumer reads them; the split
+    # lockstep driver's packed output forwards them for observability only
     return (Hb[:R], E1b[:R], E2b[:R], F1b[:R], F2b[:R],
-            dp_beg[:R], dp_end[:R], mpl[:-1], mpr[:-1], overflow, bs, bi, bj)
+            dp_beg[:R], dp_end[:R], left_r[:R], right_r[:R], overflow,
+            bs, bi, bj)
 
 
 # --------------------------------------------------------------------------- #
@@ -687,11 +748,14 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
         m_sel = m1 | m2_sel
 
         op_code = jnp.where(m_sel, 0, jnp.where(d_sel, 1, 2))
-        # masked scatter: an out-of-bounds index drops the write (inactive or
-        # dead-end sub-steps record nothing)
+        # masked write via dynamic-update-slice into the spill row (max_ops,
+        # sliced off at return): inactive or dead-end sub-steps record
+        # nothing. DUS, not a masked `.at` scatter — XLA:CPU serializes
+        # vmapped masked scatters per element (ROUND8_NOTES.md) and this
+        # backtrack runs vmapped inside the lockstep DP chunk.
         wr = jnp.where(c & (~no_hit), n_ops, jnp.int32(max_ops))
-        ops = ops.at[wr, 0].set(op_code)
-        ops = ops.at[wr, 1].set(i)
+        ops = lax.dynamic_update_slice(
+            ops, jnp.stack([op_code, i]).reshape(1, 2), (wr, jnp.int32(0)))
 
         pre_m = pidx[first_m]
         pre_d = pidx[first_d]
@@ -717,18 +781,36 @@ def _backtrack_w(H, E1, E2, F1, F2, dp_beg, dp_end, pre_idx, pre_msk,
             st = body1(st)
         return st
 
-    ops0 = jnp.zeros((max_ops, 2), jnp.int32)
+    ops0 = jnp.zeros((max_ops + 1, 2), jnp.int32)  # +1: the DUS spill row
     st0 = (best_i, best_j, i32(C.ALL_OP),
            i32(1 if put_gap_at_end else 0), i32(0), ops0,
            i32(0), i32(0), jnp.bool_(False), jnp.bool_(False))
     st = lax.while_loop(cond, body, st0)
     (i, j, _co, _lg, n_ops, ops, n_aln, n_match, err, _done) = st
-    return ops, n_ops, i, j, n_aln, n_match, err
+    return ops[:max_ops], n_ops, i, j, n_aln, n_match, err
 
 
 # --------------------------------------------------------------------------- #
 # vectorized fusion                                                           #
 # --------------------------------------------------------------------------- #
+
+def spill_scatter(arr, idx, valid, vals, op: str = "set"):
+    """THE extra-slot masked-scatter convention, in one place.
+
+    Scatter `vals` into `arr` along axis 0 at `idx` where `valid`; rows with
+    `valid` False are routed to a spill slot appended past the end for the
+    write and sliced off before returning, so they drop without branching.
+    Every fused-loop scatter site used to re-derive this `T + 1`/`N + 1`
+    pad-route-slice dance inline; the drift test (tests/test_fused_loop.py)
+    pins the convention here.
+
+    op: "set" | "add" | "max" | "min" — the `.at[...]` update applied.
+    """
+    S = arr.shape[0]
+    tgt = jnp.where(valid, idx, jnp.int32(S)).astype(jnp.int32)
+    padded = jnp.pad(arr, [(0, 1)] + [(0, 0)] * (arr.ndim - 1))
+    return getattr(padded.at[tgt], op)(vals)[:S]
+
 
 def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
                      weight):
@@ -738,6 +820,17 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     Safe because an alignment is a simple path: each graph node is touched at
     most once, so every edge append/reweight lands in a distinct slot
     (semantics: abpoa_graph.c:689-774 with inc_both_ends=1, no read-id bitsets).
+
+    Scatter budget: the whole update lowers to EXACTLY four scatter sites
+    (the structural jaxpr test pins <= 4) — one rank-indexed path-plane
+    scatter, one out-adjacency scatter-add, one in-adjacency scatter-add,
+    and one aligned-group scatter-add. Everything else that used to scatter
+    (base/n_span writes, edge counts, n_read, collision counting) now rides
+    those four as extra plane columns, or became sort/gather/contiguous-
+    dynamic-update-slice work: XLA:CPU lowers a vmapped masked scatter to a
+    per-element loop, and the ~15 scatters this function used to perform
+    were the measured reason K=4 lockstep ran 1.37x slower than serial
+    (ROUND8_NOTES.md, BENCH_lockstep_cpu.json).
 
     Returns (g', path_nodes, path_len, path_new, collision) where collision
     means two ops interacted with one aligned group (caller must use the
@@ -770,15 +863,16 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     mm_new = mm & ~has_aln
 
     # collision: two ops of this read touching the same aligned group would
-    # need sequential semantics (a node created by op k visible to op k' > k)
+    # need sequential semantics (a node created by op k visible to op k' > k).
+    # Scatter-free duplicate detection: sort the touched group roots (with
+    # distinct >= N fillers for untouched ops) and look for equal neighbors.
     grp_root = jnp.where(
         g.aligned_cnt[node] > 0,
         jnp.minimum(node, jnp.min(jnp.where(grp_ok, grp_ids, N), axis=1)),
         node).astype(jnp.int32)
     touch = mm
-    root_cnt = jnp.zeros(N + 1, jnp.int32).at[
-        jnp.where(touch, grp_root, N)].add(1)
-    collision = jnp.any(root_cnt[:N] > 1)
+    root_keys = jnp.sort(jnp.where(touch, grp_root, jnp.int32(N) + t))
+    collision = jnp.any(root_keys[1:] == root_keys[:-1])
 
     is_new = is_ins | mm_new
     new_rank = jnp.cumsum(is_new.astype(jnp.int32)) - is_new.astype(jnp.int32)
@@ -792,18 +886,21 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     rank = jnp.cumsum(is_path.astype(jnp.int32)) - is_path.astype(jnp.int32)
     L = jnp.sum(is_path.astype(jnp.int32))
 
-    # dense path arrays (rank-indexed, extra slot for masked scatters)
-    tgt = jnp.where(is_path, rank, T)
-    path_nodes = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
-        jnp.where(is_path, path_node, 0))
-    path_w = jnp.zeros(T + 1, jnp.int32).at[tgt].set(jnp.where(is_path, wt, 0))
-    path_new = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
-        jnp.where(is_path, is_new.astype(jnp.int32), 0))
-    path_qpos = jnp.zeros(T + 1, jnp.int32).at[tgt].set(
-        jnp.where(is_path, qpos, 0))
+    # dense rank-indexed path plane: node id / edge weight / is-new per
+    # path rank, built by ONE scatter (scatter site 1 of 4)
+    path_plane = spill_scatter(
+        jnp.zeros((T + 1, 3), jnp.int32), rank, is_path,
+        jnp.stack([path_node, wt, is_new.astype(jnp.int32)], axis=1))
+    path_nodes = path_plane[:, 0]
+    path_w = path_plane[:, 1]
+    path_new = path_plane[:, 2]
 
     # ---- new node bases + n_span (value of nearest old path node before) ----
-    nb = jnp.zeros(T + 1, jnp.int32).at[tgt].set(jnp.where(is_path, b, 0))
+    # both are node-indexed writes into previously-zero rows (ids >= the old
+    # node_n), so they ride the aligned-group scatter-add below as two extra
+    # plane columns — a dynamic-update-slice at the contiguous new-id range
+    # would stay cheap unbatched but lowers to a scatter under vmap (batched
+    # start index), breaking the 4-site budget on the mesh path
     r_ = jnp.arange(T + 1, dtype=jnp.int32)
     is_old_path = (r_ < L) & (path_new == 0)
     # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate methods
@@ -811,16 +908,19 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     last_old = lax.cummax(jnp.where(is_old_path, r_, -1))
     span_src = jnp.where(last_old >= 0, path_nodes[jnp.clip(last_old, 0, T)],
                          C.SRC_NODE_ID)
-    n_span_val = g.n_span[span_src]
-    new_sel = (r_ < L) & (path_new == 1)
-    node_tgt = jnp.where(new_sel, path_nodes, N)
-    base = jnp.pad(g.base, (0, 1))
-    base = base.at[node_tgt].set(jnp.where(new_sel, nb, base[node_tgt]))[:-1]
-    n_span = jnp.pad(g.n_span, (0, 1))
-    n_span = n_span.at[node_tgt].set(
-        jnp.where(new_sel, n_span_val, n_span[node_tgt]))[:-1]
+    n_span_val = g.n_span[span_src]          # (T+1,) by path rank
+    n_span_t = n_span_val[jnp.clip(rank, 0, T)]  # per-op value (t domain)
 
     # ---- edges: (fr, to, w, check) for ranks 0..L (L+1 edges) ---------------
+    # Adjacency updates ride ONE scatter-add per direction (scatter sites 2
+    # and 3 of 4) into a merged (N, E+1, 2) plane: columns 0..E-1 hold
+    # (edge id, edge weight) pairs, column E holds the per-node counters
+    # (slot count, n_read for out / unused for in). Additive updates are
+    # exact because edge ranks hit distinct nodes (the path property), slots
+    # past a node's count are invariantly zero (edges are never removed),
+    # an existing edge's id delta is 0, and a new edge adds its id into a
+    # zero slot. One-hot update rows are built by rank — the "rank-indexed
+    # dense planes" of ROUND8_NOTES.md.
     er = jnp.arange(T + 1, dtype=jnp.int32)
     e_valid = er <= L
     fr = jnp.where(er == 0, C.SRC_NODE_ID, path_nodes[jnp.clip(er - 1, 0, T)])
@@ -830,78 +930,105 @@ def _fuse_vectorized(g: DeviceGraph, fwd_op, fwd_arg, n_fwd, query, qlen,
     prev_new = jnp.where(er == 0, 0, path_new[jnp.clip(er - 1, 0, T)])
     check = (prev_new == 0)
 
-    fr_s = jnp.where(e_valid, fr, N)
-    to_s = jnp.where(e_valid, to, N)
+    fr_c = jnp.clip(fr, 0, N - 1)
+    to_c = jnp.clip(to, 0, N - 1)
+    ecols = jnp.arange(E, dtype=jnp.int32)
 
-    # out-slot search on fr
-    ocnt = jnp.pad(g.out_cnt, (0, 1))
-    oids = jnp.pad(g.out_ids, ((0, 1), (0, 0)))
-    ow = jnp.pad(g.out_w, ((0, 1), (0, 0)))
-    om = (jnp.arange(E)[None, :] < ocnt[fr_s][:, None]) & (oids[fr_s] == to_s[:, None])
-    o_exists = check & jnp.any(om, axis=1) & e_valid
-    o_slot = jnp.where(o_exists, jnp.argmax(om, axis=1), ocnt[fr_s]).astype(jnp.int32)
-    edge_cap = jnp.any(e_valid & (o_slot >= E))
-    o_slot_c = jnp.clip(o_slot, 0, E - 1)
-    oids = oids.at[fr_s, o_slot_c].set(jnp.where(e_valid, to_s, oids[fr_s, o_slot_c]))
-    ow = ow.at[fr_s, o_slot_c].set(
-        jnp.where(e_valid, jnp.where(o_exists, ow[fr_s, o_slot_c] + ew, ew),
-                  ow[fr_s, o_slot_c]))
-    ocnt = ocnt.at[fr_s].set(jnp.where(e_valid & ~o_exists, ocnt[fr_s] + 1, ocnt[fr_s]))
+    def _adj_update(ids, w, cnt, extra, row, other):
+        """One-direction adjacency update: returns the updated
+        (ids, w, cnt, extra) after a single scatter-add of one-hot rank
+        rows. `row`/`other` are the indexed node and the far endpoint;
+        `extra` rides the counter column's second feature (n_read)."""
+        rc = jnp.clip(row, 0, N - 1)
+        cnt_r = cnt[rc]
+        m = (ecols[None, :] < cnt_r[:, None]) & (ids[rc] == other[:, None])
+        exists = check & jnp.any(m, axis=1) & e_valid
+        slot = jnp.where(exists, jnp.argmax(m, axis=1), cnt_r).astype(
+            jnp.int32)
+        cap = jnp.any(e_valid & (slot >= E))
+        slot_c = jnp.clip(slot, 0, E - 1)
+        hot = ecols[None, :] == slot_c[:, None]                    # (T+1, E)
+        new_e = (~exists) & e_valid
+        upd_id = jnp.where(hot & new_e[:, None], other[:, None], 0)
+        upd_w = jnp.where(hot, ew[:, None], 0)
+        upd_slot = jnp.stack([upd_id, upd_w], axis=-1)             # (T+1,E,2)
+        upd_cnt = jnp.stack([new_e.astype(jnp.int32),
+                             jnp.ones(T + 1, jnp.int32)], axis=-1)
+        upd = jnp.concatenate([upd_slot, upd_cnt[:, None, :]], axis=1)
+        plane = jnp.concatenate([
+            jnp.stack([ids, w], axis=-1),
+            jnp.stack([cnt, extra], axis=-1)[:, None, :]], axis=1)
+        plane = spill_scatter(plane, row, e_valid, upd, op="add")
+        return (plane[:, :E, 0], plane[:, :E, 1], plane[:, E, 0],
+                plane[:, E, 1], cap)
 
-    icnt = jnp.pad(g.in_cnt, (0, 1))
-    iids = jnp.pad(g.in_ids, ((0, 1), (0, 0)))
-    iw = jnp.pad(g.in_w, ((0, 1), (0, 0)))
-    im = (jnp.arange(E)[None, :] < icnt[to_s][:, None]) & (iids[to_s] == fr_s[:, None])
-    i_exists = check & jnp.any(im, axis=1) & e_valid
-    i_slot = jnp.where(i_exists, jnp.argmax(im, axis=1), icnt[to_s]).astype(jnp.int32)
-    edge_cap = edge_cap | jnp.any(e_valid & (i_slot >= E))
-    i_slot_c = jnp.clip(i_slot, 0, E - 1)
-    iids = iids.at[to_s, i_slot_c].set(jnp.where(e_valid, fr_s, iids[to_s, i_slot_c]))
-    iw = iw.at[to_s, i_slot_c].set(
-        jnp.where(e_valid, jnp.where(i_exists, iw[to_s, i_slot_c] + ew, ew),
-                  iw[to_s, i_slot_c]))
-    icnt = icnt.at[to_s].set(jnp.where(e_valid & ~i_exists, icnt[to_s] + 1, icnt[to_s]))
+    oids, ow, ocnt, n_read, o_cap = _adj_update(
+        g.out_ids, g.out_w, g.out_cnt, g.n_read, fr_c, to)
+    iids, iw, icnt, _unused, i_cap = _adj_update(
+        g.in_ids, g.in_w, g.in_cnt, jnp.zeros(N, jnp.int32), to_c, fr)
+    edge_cap = o_cap | i_cap
 
-    n_read = jnp.pad(g.n_read, (0, 1)).at[fr_s].add(
-        jnp.where(e_valid, 1, 0))[:-1]
-
-    # ---- aligned-group registration for mismatch-new nodes ------------------
-    # each op's group is distinct (collision excluded) -> parallel scatters
-    mmn_node = jnp.where(mm_new, node, N)                       # (T,)
-    mmn_newid = jnp.where(mm_new, new_id, N)
-    acnt = jnp.pad(g.aligned_cnt, (0, 1))
-    aids = jnp.pad(g.aligned, ((0, 1), (0, 0)))
-    # existing members gain the new node; the new node gains all members + node
-    memb_ok = (jnp.arange(A)[None, :] < acnt[mmn_node][:, None]) & mm_new[:, None]
+    # ---- aligned-group registration + new-node base/n_span ------------------
+    # Each op's group is distinct (collision excluded), members within a
+    # group are distinct, and a new node's rows start all-zero — so every
+    # update is an append into a zero slot plus a count bump, and the four
+    # update kinds (existing members gain the new node; the group node gains
+    # the new node; the new node's row gains members + node + its count; a
+    # new node's base/n_span) flatten into ONE scatter-add of one-hot rows
+    # over a merged (N, A+3) plane: cols 0..A-1 aligned ids, col A count,
+    # col A+1 base, col A+2 n_span (scatter site 4 of 4).
+    acnt_node = g.aligned_cnt[node]                             # (T,) pre-read
+    memb_ok = (jnp.arange(A)[None, :] < acnt_node[:, None]) & mm_new[:, None]
     memb = jnp.where(memb_ok, grp_ids, N)                       # (T, A)
-    grp_full = jnp.any(mm_new & (acnt[mmn_node] + 1 > A)) | \
-        jnp.any(memb_ok & (acnt[jnp.clip(memb, 0, N)] + 1 > A))
-    # member rows: append new_id at slot acnt[member]
-    m_slot = jnp.clip(acnt[jnp.clip(memb, 0, N)], 0, A - 1)
-    aids = aids.at[jnp.clip(memb, 0, N + 0), m_slot].set(
-        jnp.where(memb_ok, mmn_newid[:, None], aids[jnp.clip(memb, 0, N), m_slot]))
-    acnt = acnt.at[jnp.clip(memb, 0, N)].add(jnp.where(memb_ok, 1, 0))
-    # node row: append new_id
-    n_slot = jnp.clip(acnt[mmn_node], 0, A - 1)
-    aids = aids.at[mmn_node, n_slot].set(
-        jnp.where(mm_new, mmn_newid, aids[mmn_node, n_slot]))
-    acnt = acnt.at[mmn_node].add(jnp.where(mm_new, 1, 0))
-    # new row: all members then node
-    k_a = jnp.arange(A)[None, :]
-    new_row = jnp.where(k_a < acnt[mmn_node][:, None] - 1, memb,
-                        jnp.where(k_a == acnt[mmn_node][:, None] - 1,
-                                  mmn_node[:, None], 0))
-    aids = aids.at[mmn_newid].set(
-        jnp.where(mm_new[:, None], new_row, aids[mmn_newid]))
-    acnt = acnt.at[mmn_newid].set(
-        jnp.where(mm_new, acnt[mmn_node], acnt[mmn_newid]))
+    memb_c = jnp.clip(memb, 0, N - 1)
+    acnt_memb = g.aligned_cnt[memb_c]                           # (T, A)
+    grp_full = jnp.any(mm_new & (acnt_node + 1 > A)) | \
+        jnp.any(memb_ok & (acnt_memb + 1 > A))
+    k_a = jnp.arange(A, dtype=jnp.int32)[None, :]
+    z1 = jnp.zeros((T, 2), jnp.int32)       # base/n_span cols, untouched
+    # (a) member rows: one-hot new_id at slot acnt[member], count +1
+    m_slot = jnp.clip(acnt_memb, 0, A - 1).reshape(T * A)       # (T*A,)
+    m_upd_ids = jnp.where(
+        jnp.arange(A)[None, :] == m_slot[:, None],
+        jnp.repeat(new_id, A)[:, None], 0)                      # (T*A, A)
+    m_upd = jnp.concatenate(
+        [m_upd_ids, jnp.ones((T * A, 1), jnp.int32),
+         jnp.zeros((T * A, 2), jnp.int32)], axis=1)             # (T*A, A+3)
+    # (b) the group node's row: one-hot new_id at slot acnt[node], count +1
+    n_slot = jnp.clip(acnt_node, 0, A - 1)
+    n_upd = jnp.concatenate(
+        [jnp.where(k_a == n_slot[:, None], new_id[:, None], 0),
+         jnp.ones((T, 1), jnp.int32), z1], axis=1)              # (T, A+3)
+    # (c) the new node's row: members, then node, count = acnt[node] + 1
+    c_vals = jnp.where(k_a < acnt_node[:, None], jnp.where(memb_ok, grp_ids, 0),
+                       jnp.where(k_a == acnt_node[:, None], node[:, None], 0))
+    c_upd = jnp.concatenate(
+        [c_vals, (acnt_node + 1)[:, None], z1], axis=1)         # (T, A+3)
+    # (d) every new node's base/n_span (insertions included — not just
+    # mismatch-new), zeros in the aligned columns
+    d_upd = jnp.concatenate(
+        [jnp.zeros((T, A + 1), jnp.int32), b[:, None],
+         n_span_t[:, None]], axis=1)                            # (T, A+3)
+    a_idx = jnp.concatenate([memb.reshape(T * A), node, new_id, new_id])
+    a_valid = jnp.concatenate([memb_ok.reshape(T * A), mm_new, mm_new,
+                               is_new])
+    a_upd = jnp.concatenate([m_upd, n_upd, c_upd, d_upd], axis=0)
+    a_plane = jnp.concatenate(
+        [g.aligned, g.aligned_cnt[:, None], g.base[:, None],
+         g.n_span[:, None]], axis=1)                            # (N, A+3)
+    a_plane = spill_scatter(a_plane, jnp.clip(a_idx, 0, N - 1), a_valid,
+                            a_upd, op="add")
+    aids = a_plane[:, :A]
+    acnt = a_plane[:, A]
+    base = a_plane[:, A + 1]
+    n_span = a_plane[:, A + 2]
 
     node_n = g.node_n + n_new
     g2 = g._replace(
         base=base, n_span=n_span, n_read=n_read,
-        in_ids=iids[:-1], in_w=iw[:-1], in_cnt=icnt[:-1],
-        out_ids=oids[:-1], out_w=ow[:-1], out_cnt=ocnt[:-1],
-        aligned=aids[:-1], aligned_cnt=acnt[:-1],
+        in_ids=iids, in_w=iw, in_cnt=icnt,
+        out_ids=oids, out_w=ow, out_cnt=ocnt,
+        aligned=aids, aligned_cnt=acnt,
         node_n=node_n, ok=g.ok & (node_n <= N))
     return g2, path_nodes, L, path_new, collision, edge_cap, grp_full
 
@@ -925,18 +1052,17 @@ def _splice_order(order, n2i, old_n, new_n, path_nodes, path_len, path_new):
     anchor_pos = n2i[anchor_node]                                 # (T1,)
 
     # per-gap new-node counts -> position shifts for old nodes
-    counts = jnp.zeros(N + 1, jnp.int32).at[
-        jnp.where(is_new, anchor_pos, N)].add(1)
-    shift = jnp.cumsum(counts[:N])          # shift[p] = #new at gaps <= p
-    shift_excl = shift - counts[:N]         # #new at gaps < p
+    counts = spill_scatter(jnp.zeros(N, jnp.int32), anchor_pos, is_new,
+                           jnp.ones(T1, jnp.int32), op="add")
+    shift = jnp.cumsum(counts)              # shift[p] = #new at gaps <= p
+    shift_excl = shift - counts             # #new at gaps < p
     # old nodes at position p move past all new nodes of earlier gaps; their
     # own gap's new nodes come directly after them
     pos = jnp.arange(N, dtype=jnp.int32)
     old_active = pos < old_n
     new_pos_old = pos + shift_excl
-    order2 = jnp.zeros(N + 1, jnp.int32).at[
-        jnp.where(old_active, new_pos_old, N)].set(
-        jnp.where(old_active, order, 0))[:-1]
+    order2 = spill_scatter(jnp.zeros(N, jnp.int32), new_pos_old, old_active,
+                           jnp.where(old_active, order, 0))
     # rank of a new node within its gap = running count among new ranks since
     # the last old path node
     cum_new = jnp.cumsum(is_new.astype(jnp.int32))
@@ -945,12 +1071,11 @@ def _splice_order(order, n2i, old_n, new_n, path_nodes, path_len, path_new):
     shift_before = jnp.where(anchor_pos > 0,
                              shift[jnp.clip(anchor_pos - 1, 0, N - 1)], 0)
     npos = anchor_pos + shift_before + 1 + within
-    order2 = jnp.pad(order2, (0, 1)).at[
-        jnp.where(is_new, npos, N)].set(
-        jnp.where(is_new, path_nodes, 0))[:-1]
+    order2 = spill_scatter(order2, npos, is_new,
+                           jnp.where(is_new, path_nodes, 0))
     active2 = pos < new_n
-    n2i2 = jnp.zeros(N + 1, jnp.int32).at[
-        jnp.where(active2, order2, N)].set(jnp.where(active2, pos, 0))[:-1]
+    n2i2 = spill_scatter(jnp.zeros(N, jnp.int32), order2, active2,
+                         jnp.where(active2, pos, 0))
     return order2, n2i2
 
 
@@ -978,9 +1103,9 @@ def _build_tables(g: DeviceGraph, order, n2i, remain):
     mpr0 = jnp.zeros(N, jnp.int32)
     src_out = out_idx[0]
     src_m = jnp.arange(E) < g.out_cnt[nid[0]]
-    tgt = jnp.where(src_m, src_out, N - 1)
-    mpl0 = mpl0.at[tgt].set(jnp.where(src_m, 1, mpl0[tgt]))
-    mpr0 = mpr0.at[tgt].set(jnp.where(src_m, 1, mpr0[tgt]))
+    ones_e = jnp.ones(E, jnp.int32)
+    mpl0 = spill_scatter(mpl0, src_out, src_m, ones_e)
+    mpr0 = spill_scatter(mpr0, src_out, src_m, ones_e)
     return (base_r, pre_idx, pre_msk, out_idx, out_msk, row_active,
             remain_rows, mpl0, mpr0)
 
@@ -1036,8 +1161,8 @@ def _seed_state(state: FusedState, query, qlen, weight) -> FusedState:
                                 jnp.where(pos == node_n - 1, C.SINK_NODE_ID, 0)))
     order = order.astype(jnp.int32)
     active = pos < node_n
-    n2i = jnp.zeros(N + 1, jnp.int32).at[
-        jnp.where(active, order, N)].set(jnp.where(active, pos, 0))[:-1]
+    n2i = spill_scatter(jnp.zeros(N, jnp.int32), order, active,
+                        jnp.where(active, pos, 0))
     # remain along the chain: remain[v] = node_n - 2 - position(v)
     # (src qlen+1 ... last seq node 0, sink -1), no override needed
     remain_by_node = jnp.where(jnp.arange(N) < node_n,
@@ -1377,10 +1502,12 @@ def run_fused_chunk(state: FusedState, seqs_pad, wgts_pad, lens, n_reads,
                     jnp.where(keep, st.path_lens[st.read_idx], path_len))
             else:
                 paths, path_lens = st.paths, st.path_lens
-            rc_tgt = jnp.where(keep, jnp.int32(st.rc_flags.shape[0]),
-                               st.read_idx)
-            rc_flags = st.rc_flags.at[rc_tgt].set(
-                use_rc.astype(jnp.int32))  # OOB scatter drops (dummy buffer)
+            # dummy (1,)-sized buffer when amb is off: read_idx past its end
+            # routes to the spill slot and drops
+            rc_flags = spill_scatter(
+                st.rc_flags, jnp.minimum(st.read_idx,
+                                         jnp.int32(st.rc_flags.shape[0])),
+                ~keep, use_rc.astype(jnp.int32))
             return FusedState(
                 g=g_out,
                 order=jnp.where(keep, order, order3),
@@ -1943,8 +2070,13 @@ def progressive_poa_fused_batch(seq_sets: List[List[np.ndarray]],
             # K-rung padding slots are born finished and don't count)
             if finished_prev[:K].any():
                 count("lockstep.drain_chunks")
-            observe("lockstep.noop_set_fraction",
-                    float(finished_prev[:K].mean()))
+            noop = float(finished_prev[:K].mean())
+            observe("lockstep.noop_set_fraction", noop)
+            # divergence feedback for the scheduler's K cap — the device
+            # impl must feed the EWMA too, or the serve/-l re-cap loops
+            # would only ever engage on the split driver
+            from ..parallel import scheduler as _sched
+            _sched.observe_noop_fraction(noop)
 
             kwargs = _static_chunk_kwargs(
                 abpt, W=W, max_ops=max_ops, plane16=plane16,

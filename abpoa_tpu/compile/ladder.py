@@ -51,6 +51,11 @@ LADDER = {
         "R": GEOM_64, "Qp": GEOM_128, "P": POW2, "O": POW2,
         "SR": POW2, "B": POW2,
     },
+    # split lockstep (fusion off the batch axis): banded DP + backtrack
+    # only, vmapped over the K set axis; graphs live on the host
+    "run_dp_chunk": {
+        "R": GEOM_64, "Qp": GEOM_128, "W": POW2_128, "P": POW2, "K": POW2,
+    },
 }
 
 
@@ -144,14 +149,20 @@ class WarmAnchor(NamedTuple):
 QUICK_TIER: Tuple[WarmAnchor, ...] = (
     WarmAnchor("run_fused_chunk", qmax=240, n_reads=8, growth=2),
     WarmAnchor("run_fused_chunk", qmax=2200, n_reads=20, growth=1),
+    # split-lockstep DP chunk at the bench/gate protocol shape (2 kb
+    # reads, K=4 + repack halvings): covers tools/lockstep_gate.py and
+    # the BENCH_lockstep_cpu K=4 row, same Qp rung as the 2200 fused
+    # anchor above
+    WarmAnchor("run_dp_chunk", qmax=2200, n_reads=20, growth=2, k=4),
 )
 
 # full: quick + the north-star 10 kb consensus shape, the lockstep `-l`
-# group shape, and the seeded-window batch.
+# group shapes (all-device and split), and the seeded-window batch.
 FULL_TIER: Tuple[WarmAnchor, ...] = QUICK_TIER + (
     WarmAnchor("run_fused_chunk", qmax=10000, n_reads=500, growth=4),
     WarmAnchor("run_fused_chunk[lockstep]", qmax=10000, n_reads=10,
                growth=2, k=8),
+    WarmAnchor("run_dp_chunk", qmax=2200, n_reads=10, growth=3, k=8),
     WarmAnchor("dp_full_batch", qmax=1000, n_reads=1, growth=0, windows=8),
 )
 

@@ -45,6 +45,7 @@ def warm_ladder(tier: str = "quick", abpt=None,
     if anchors is None:
         anchors = TIERS[tier]
     # importing the drivers registers their entry points + warmers
+    from ..align import dp_chunk  # noqa: F401
     from ..align import fused_loop  # noqa: F401
     from ..align import jax_backend  # noqa: F401
 

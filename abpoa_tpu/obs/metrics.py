@@ -403,6 +403,8 @@ _PREFIX_FAMILIES = {
                "Absorbed dispatch/input faults by kind", "kind"),
     "inject": ("abpoa_injected_faults_total",
                "Fault-injector firings by kind", "kind"),
+    "scheduler": ("abpoa_scheduler_routes_total",
+                  "Batch/serve dispatch route decisions by route", "route"),
 }
 
 _EXACT_FAMILIES = {
@@ -424,6 +426,16 @@ _EXACT_FAMILIES = {
                               "circuit breaker"),
     "lockstep.groups": ("abpoa_lockstep_groups_total",
                         "Lockstep multi-set device dispatch groups"),
+    "lockstep.chunks": ("abpoa_lockstep_chunks_total",
+                        "Lockstep dispatch rounds/chunks (all-device "
+                        "chunks, or split-driver DP rounds)"),
+    "lockstep.drain_chunks": ("abpoa_lockstep_drain_chunks_total",
+                              "Lockstep rounds entered with at least one "
+                              "set already finished (divergence drain)"),
+    "lockstep.split_bt_fallback": ("abpoa_lockstep_split_bt_fallbacks_total",
+                                   "Split-lockstep sets sent to the "
+                                   "sequential path by a device backtrack "
+                                   "divergence"),
     "dp.dispatches": ("abpoa_dp_dispatches_total", "DP kernel dispatches"),
     "dp.cells": ("abpoa_dp_cells_total", "DP cells computed"),
     "dp.cell_ops": ("abpoa_dp_cell_ops_total",
@@ -551,6 +563,34 @@ def set_breaker_state(backend: str, open_: bool) -> None:
             "abpoa_breaker_open",
             "Circuit-breaker state by backend (1 = open/demoted)").set(
             1 if open_ else 0, backend=backend)
+
+
+_ROUTE_KINDS = ("serial", "pool", "lockstep", "hybrid")
+
+
+def publish_noop_fraction(ewma: float) -> None:
+    """Lockstep idle-lane divergence EWMA (the scheduler's K-cap input)."""
+    if _ENABLED:
+        _REGISTRY.gauge(
+            "abpoa_lockstep_noop_fraction",
+            "EWMA of the lockstep idle-lane fraction (divergence; feeds "
+            "the scheduler's sub-batch K cap)").set(ewma)
+
+
+def publish_route(route) -> None:
+    """Scheduler decision gauges for `top`: the last planned route (one-hot
+    over route kinds) and its lockstep K cap."""
+    if not _ENABLED:
+        return
+    for kind in _ROUTE_KINDS:
+        _REGISTRY.gauge(
+            "abpoa_scheduler_route",
+            "Last planned batch/serve route (1 = selected)").set(
+            1 if route.kind == kind else 0, route=kind)
+    _REGISTRY.gauge(
+        "abpoa_scheduler_k_cap",
+        "Lockstep sub-batch K cap of the last planned route").set(
+        route.k_cap)
 
 
 def publish_batch_progress(done: int, total: Optional[int] = None) -> None:

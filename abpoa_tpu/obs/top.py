@@ -108,6 +108,30 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
                              1e3 * sq.get("0.5", 0), 1e3 * sq.get("0.95", 0),
                              1e3 * sq.get("0.99", 0)))
 
+    # scheduler panel (present once a batch/serve route was planned):
+    # the selected route, lockstep K cap, route-decision counts, and the
+    # measured divergence EWMA the K-cap heuristic feeds on
+    route_hot = _labeled(samples, "abpoa_scheduler_route", "route")
+    routes = _labeled(samples, "abpoa_scheduler_routes_total", "route")
+    if route_hot or routes:
+        cur = next((k for k, v in route_hot.items() if v >= 1), "?")
+        k_cap = M.sample_value(samples, "abpoa_scheduler_k_cap")
+        noop = M.sample_value(samples, "abpoa_lockstep_noop_fraction")
+        parts = [f"route {cur}"]
+        if k_cap is not None:
+            parts.append(f"k_cap {k_cap:.0f}")
+        if noop is not None:
+            parts.append(f"noop {noop:.2f} [{_bar(noop, 8)}]")
+        if routes:
+            parts.append("  ".join(f"{k}={v:.0f}"
+                                   for k, v in sorted(routes.items())))
+        lines.append("sched    " + "  ".join(parts))
+        chunks = _total(samples, "abpoa_lockstep_chunks_total")
+        drains = _total(samples, "abpoa_lockstep_drain_chunks_total")
+        if chunks:
+            lines.append(f"         lockstep rounds {chunks:.0f}  "
+                         f"drain {drains:.0f}")
+
     # process-pool panel (present only when a supervised worker pool ran:
     # -l --workers N or serve --pool-workers N)
     pool_up = M.sample_value(samples, "abpoa_pool_workers")

@@ -1,0 +1,222 @@
+"""Split lockstep: K read sets advance one read per round — host fusion
+interleaved with ONE batched device DP dispatch per round.
+
+This is ROUND8_NOTES.md's rewrite #2 ("split fusion out of the vmapped
+region entirely"): the all-device lockstep (fused_loop.
+progressive_poa_fused_batch) pays the vmapped fusion scatters and the
+vmapped while_loop's full-plane selects on every read — measured 1.37x
+SLOWER than serial at K=4 on CPU hosts. Here each set's graph lives on the
+HOST (the reference add_alignment fusion, byte-golden engine), and only the
+banded DP scan + backtrack carry the K axis (align/dp_chunk.run_dp_chunk).
+Divergence between sets is visible, not hidden: finished sets free their
+lane at pow2 repack boundaries and `lockstep.noop_set_fraction` records the
+idle-lane fraction each round — the scheduler's K-cap feedback signal.
+
+Byte parity: per read this is exactly pipeline.poa's sequence (DP at the
+pre-fusion graph, optional ambiguous-strand RC retry with the host float
+threshold, host add_alignment fusion), so outputs are byte-identical to
+the sequential host loop for any K and any set mix.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+from ..params import Params
+
+MAX_W_GROWTH = 6
+
+
+def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
+                                weight_sets: List[List[np.ndarray]],
+                                abpt: Params) -> list:
+    """Run K independent read sets in split lockstep.
+
+    Returns one entry per set: `(host_graph, is_rc_flags)`, or `None` where
+    that set must re-run on the caller's sequential path (device backtrack
+    divergence) — the same contract as progressive_poa_fused_batch, so the
+    two lockstep implementations are drop-in interchangeable at the
+    flush_lockstep_group call site.
+    """
+    from .. import obs
+    from ..align.dp_chunk import (build_lockstep_tables, chunk_plane16,
+                                  dispatch_dp_chunk, plan_degree_rung,
+                                  plan_row_rung, result_from_chunk)
+    from ..compile.ladder import k_rung, plan_chunk_buckets, qp_rung
+    from ..graph import POAGraph
+    from ..pipeline import _band_cols, _rc_encode
+    from . import scheduler
+
+    K = len(seq_sets)
+    n_reads = [len(ss) for ss in seq_sets]
+    qmax = max((len(s) for ss in seq_sets for s in ss), default=1)
+    Qp = qp_rung(qmax)
+    _qp, W, _local = plan_chunk_buckets(abpt, qmax)
+    graphs = [POAGraph() for _ in range(K)]
+    is_rc = [[False] * n for n in n_reads]
+    cursor = [0] * K
+    failed = [False] * K
+    amb = bool(abpt.amb_strand)
+    obs.observe("lockstep.k", K)
+
+    def fuse_read(k: int, res, qseq, weight) -> None:
+        g = graphs[k]
+        rid = cursor[k]
+        g.add_alignment(abpt, qseq, weight, None, res.cigar, rid,
+                        n_reads[k], True)
+        cursor[k] += 1
+
+    round_i = 0
+    while True:
+        active = [k for k in range(K)
+                  if not failed[k] and cursor[k] < n_reads[k]]
+        if not active:
+            break
+        t_round = time.perf_counter()
+        round_i += 1
+        obs.count("lockstep.chunks")
+        # idle-lane fraction: real sets already finished (or failed) out of
+        # K — the divergence signal the scheduler's K cap feeds on
+        noop = 1.0 - len(active) / K
+        obs.observe("lockstep.noop_set_fraction", noop)
+        scheduler.observe_noop_fraction(noop)
+        if noop:
+            obs.count("lockstep.drain_chunks")
+
+        # first read of a set seeds its graph: fusion only, no DP
+        from ..align.result import AlignResult
+        dp_ks = []
+        done_this_round: List[Tuple[int, int]] = []  # (set, qlen) advanced
+        for k in active:
+            if graphs[k].node_n <= 2:
+                with obs.phase("fusion"):
+                    done_this_round.append((k, len(seq_sets[k][cursor[k]])))
+                    fuse_read(k, AlignResult(), seq_sets[k][cursor[k]],
+                              weight_sets[k][cursor[k]])
+            else:
+                dp_ks.append(k)
+        if not dp_ks:
+            _record_round(abpt, done_this_round, t_round)
+            continue
+
+        with obs.phase("align"):
+            tables = []
+            for k in dp_ks:
+                q = seq_sets[k][cursor[k]]
+                obs.record_dp(graphs[k].node_n, _band_cols(abpt, len(q)),
+                              abpt.gap_mode)
+                tables.append(build_lockstep_tables(graphs[k], abpt, q, Qp))
+            R = plan_row_rung(max(t["n_rows"] for t in tables))
+            P = plan_degree_rung(max(t["pre_idx"].shape[1] for t in tables))
+            Kb = k_rung(len(dp_ks))
+            plane16 = chunk_plane16(
+                abpt, qmax, max(t["n_rows"] for t in tables))
+            # the W-growth retry wraps BOTH dispatches: a band overflow on
+            # either strand (result_from_chunk returns an empty cigar for
+            # it) regrows W and replays the round — an overflowed result
+            # must never reach fusion
+            for _g in range(MAX_W_GROWTH + 1):
+                packed = dispatch_dp_chunk(abpt, tables, Kb, R, P, Qp, W,
+                                           plane16)
+                results = [result_from_chunk(
+                    abpt, packed[i], tables[i],
+                    graphs[k].index_to_node_id) for i, k in
+                    enumerate(dp_ks)]
+                overflowed = any(f["overflow"] for _res, f in results)
+                if amb and not overflowed:
+                    # ambiguous-strand rescue, host threshold exactly as
+                    # pipeline.poa: a sub-threshold forward score retries
+                    # the reverse complement against the SAME tables (the
+                    # graph is untouched until fusion) in one extra
+                    # batched dispatch
+                    rc_ks = []
+                    for i, k in enumerate(dp_ks):
+                        res, _f = results[i]
+                        q = seq_sets[k][cursor[k]]
+                        thr = (min(len(q), graphs[k].node_n - 2)
+                               * abpt.max_mat * 0.3333)
+                        if res.best_score < thr:
+                            rc_ks.append(i)
+                    if rc_ks:
+                        rc_tables = []
+                        for i in rc_ks:
+                            k = dp_ks[i]
+                            q = seq_sets[k][cursor[k]]
+                            rc_q = _rc_encode(q)
+                            obs.record_dp(graphs[k].node_n,
+                                          _band_cols(abpt, len(rc_q)),
+                                          abpt.gap_mode)
+                            t = dict(tables[i])
+                            qp = np.zeros_like(t["qp"])
+                            query_pad = np.zeros_like(t["query"])
+                            if len(rc_q):
+                                qp[:, 1: len(rc_q) + 1] = abpt.mat[:, rc_q]
+                                query_pad[:len(rc_q)] = rc_q
+                            t["qp"] = qp
+                            t["query"] = query_pad
+                            rc_tables.append(t)
+                        rc_packed = dispatch_dp_chunk(abpt, rc_tables, Kb,
+                                                      R, P, Qp, W, plane16)
+                        for j, i in enumerate(rc_ks):
+                            k = dp_ks[i]
+                            rc_res, rc_f = result_from_chunk(
+                                abpt, rc_packed[j], rc_tables[j],
+                                graphs[k].index_to_node_id)
+                            if rc_f["overflow"]:
+                                overflowed = True
+                            elif rc_f["bt_err"]:
+                                results[i] = (results[i][0],
+                                              {"overflow": False,
+                                               "bt_err": True})
+                            elif (rc_res.best_score
+                                  > results[i][0].best_score):
+                                results[i] = (rc_res,
+                                              {"overflow": False,
+                                               "bt_err": False,
+                                               "rc": True})
+                if not overflowed:
+                    break
+                W *= 2
+                obs.count("fused.grow.band")
+            else:
+                raise RuntimeError(
+                    "split lockstep: band growth did not converge")
+
+        with obs.phase("fusion"):
+            for i, k in enumerate(dp_ks):
+                res, f = results[i]
+                if f["bt_err"]:
+                    # device backtrack diverged: this set re-runs on the
+                    # caller's sequential path (same contract as the
+                    # all-device lockstep)
+                    failed[k] = True
+                    obs.count("lockstep.split_bt_fallback")
+                    continue
+                q = seq_sets[k][cursor[k]]
+                wgt = weight_sets[k][cursor[k]]
+                if f.get("rc"):
+                    is_rc[k][cursor[k]] = True
+                    q = _rc_encode(q)
+                    wgt = wgt[::-1].copy()
+                done_this_round.append((k, len(q)))
+                fuse_read(k, res, q, wgt)
+
+        _record_round(abpt, done_this_round, t_round)
+
+    return [None if failed[k] else (graphs[k], is_rc[k]) for k in range(K)]
+
+
+def _record_round(abpt: Params, done: List[Tuple[int, int]],
+                  t_round: float) -> None:
+    """Amortized per-read latency records (the lockstep contract: a share
+    of the round wall per advanced read, flagged amortized)."""
+    if not done:
+        return
+    from .. import obs
+    from ..pipeline import _band_cols
+    share = (time.perf_counter() - t_round) / len(done)
+    for _k, qlen in done:
+        obs.record_read(share, qlen, _band_cols(abpt, qlen),
+                        abpt.device, amortized=True)

@@ -167,31 +167,39 @@ def rss_limit_bytes(est_bytes: Optional[int] = None) -> int:
     return 0
 
 
+def explicit_workers(abpt) -> int:
+    """THE parser for the operator's explicit worker count: CLI
+    ``--workers`` / `Params.workers` wins, then ``ABPOA_TPU_WORKERS``.
+    Returns 0 when unset/auto; a typo'd env value warns once and counts
+    as unset (never a traceback mid-batch). Shared by resolve_workers and
+    the scheduler's hybrid opt-in so the knob has exactly one grammar."""
+    w = int(getattr(abpt, "workers", 0) or 0)
+    if w > 0:
+        return w
+    env = os.environ.get("ABPOA_TPU_WORKERS", "").strip().lower()
+    if env and env != "auto":
+        try:
+            return max(0, int(env))
+        except ValueError:
+            print(f"Warning: ignoring ABPOA_TPU_WORKERS={env!r} "
+                  "(expected an integer or 'auto')", file=sys.stderr)
+    return 0
+
+
 def resolve_workers(abpt, n_sets: int) -> int:
     """Worker-process count for a batch of `n_sets` independent sets:
-    CLI ``--workers`` / `Params.workers` wins, then ``ABPOA_TPU_WORKERS``;
-    auto = one worker per available core (the ROUND8 finding: the K=1
-    engine is the fastest per-set configuration on CPU hosts, so multiple
-    sets scale with processes, not with vmapped lockstep), never more
-    than there are sets.
+    the explicit count (explicit_workers) wins; auto = one worker per
+    available core (the ROUND8 finding: the K=1 engine is the fastest
+    per-set configuration on CPU hosts, so multiple sets scale with
+    processes, not with vmapped lockstep), never more than there are
+    sets.
 
     Auto NEVER pools device-family backends (jax/tpu/pallas): N worker
     processes would each open their own accelerator client against the
     same (often exclusive) device, and the pool branch bypasses the
     wedged-tunnel probe the in-process path runs first. An explicit
     --workers / env count is the operator's call and passes through."""
-    w = int(getattr(abpt, "workers", 0) or 0)
-    if w <= 0:
-        env = os.environ.get("ABPOA_TPU_WORKERS", "").strip().lower()
-        if env and env != "auto":
-            try:
-                w = int(env)
-            except ValueError:
-                # a typo'd knob degrades to auto with a warning, never a
-                # traceback mid-batch (same spirit as the CLI's one-line
-                # errors for bad parameters)
-                print(f"Warning: ignoring ABPOA_TPU_WORKERS={env!r} "
-                      "(expected an integer or 'auto')", file=sys.stderr)
+    w = explicit_workers(abpt)
     if w > 0:
         return max(1, min(w, max(1, n_sets)))
     if n_sets <= 1 or abpt.device in ("jax", "tpu", "pallas"):
@@ -423,7 +431,16 @@ def run_records(payload) -> dict:
     return {"text": buf.getvalue(), "quarantined": quarantined}
 
 
-_TASKS = {"file": run_file, "records": run_records}
+def run_group(payload) -> dict:
+    """One hybrid-route job: a split-lockstep group of `-l` files inside
+    this worker (the scheduler's pool-of-lockstep-groups). Per-file texts
+    come back keyed by file index so the parent emits in file order."""
+    from .runner import run_lockstep_files
+    pairs = payload  # [(file_idx, path), ...]
+    return run_lockstep_files(pairs, _W["abpt"])
+
+
+_TASKS = {"file": run_file, "records": run_records, "group": run_group}
 
 
 def worker_run_job(job_id: int, kind: str, payload, spec: str,
@@ -1343,5 +1360,93 @@ def run_pool_batch(files: Sequence[str], abpt, out_fp: IO[str],
         print(f"[abpoa_tpu::pool] SIGTERM drain: "
               f"{stats.get('cancelled', 0)} queued sets cancelled, "
               "in-flight sets finished, completed output emitted.",
+              file=sys.stderr)
+    return stats
+
+
+def run_hybrid_batch(files: Sequence[str], abpt, out_fp: IO[str],
+                     n_workers: int, k_cap: int) -> dict:
+    """The hybrid `-l` runner (scheduler route "hybrid"): the file list
+    splits into contiguous groups of `k_cap` sets, each group executes as
+    ONE pool job running the split-lockstep driver inside its worker
+    (parallel/lockstep.py), and outputs are emitted in file order — the
+    pool's containment (hard-kill deadlines, crash requeue, poison
+    quarantine) wraps whole groups instead of single sets."""
+    from ..obs import count, metrics, observe
+    stats = {"sets": len(files), "quarantined": 0}
+    if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
+        return stats
+    groups = [list(enumerate(files))[i:i + k_cap]
+              for i in range(0, len(files), max(1, k_cap))]
+    pool = WorkerPool(n_workers, abpt, label="hybrid")
+    count("pool.runs")
+    observe("pool.workers", pool.n_workers)
+    metrics.publish_batch_progress(0, total=len(files))
+    # a group job is len(grp) sets' worth of work: scale the hard-kill
+    # deadline accordingly, or a healthy k_cap-set group would be killed
+    # at the single-set budget
+    base_deadline = job_deadline_s()
+    jobs = [pool.submit("group", grp,
+                        label=f"group[{grp[0][0]}..{grp[-1][0]}]",
+                        deadline_s=(base_deadline * len(grp)
+                                    if base_deadline > 0 else None))
+            for grp in groups]
+    # graceful SIGTERM drain, same contract as run_pool_batch: queued
+    # groups cancel, in-flight groups finish, completed output is
+    # emitted, rc stays 0 (main-thread CLI runs only)
+    drained = {"hit": False}
+    old_handler = None
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
+        def _on_term(signum, _frame):
+            drained["hit"] = True
+            pool.drain_intake()
+        try:
+            old_handler = signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            old_handler = None
+    try:
+        pool.start()
+        for grp, job in zip(groups, jobs):
+            job.done.wait()
+            # every set of the group reaches a terminal disposition here
+            # (emitted, quarantined, or cancelled): the batch moved past
+            # it either way — same 'done' definition as run_pool_batch
+            for _ in grp:
+                metrics.bump_batch_set_done()
+            if job.status == "ok":
+                texts = job.result.get("texts", {})
+                quar = set(job.result.get("quarantined", ()))
+                for idx, _fn in grp:
+                    out_fp.write(texts.get(idx, ""))
+                stats["quarantined"] += len(quar)
+                _archive_job(job, abpt,
+                             "quarantined" if quar else "ok")
+                try:
+                    out_fp.flush()
+                except (AttributeError, OSError):
+                    pass
+            elif job.status in ("poison", "timeout"):
+                # a whole group quarantined: the containment unit of the
+                # hybrid route is the group
+                stats["quarantined"] += len(grp)
+                _archive_job(job, abpt, job.status)
+            elif job.status == "cancelled":
+                stats["cancelled"] = stats.get("cancelled", 0) + len(grp)
+            else:
+                raise PoolWorkerError(
+                    f"hybrid group failed on {job.label!r}: {job.error}")
+            job.result = {}
+    finally:
+        pool.close(graceful=True)
+        if in_main and old_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, old_handler)
+            except (ValueError, OSError):
+                pass
+    if drained["hit"]:
+        print(f"[abpoa_tpu::pool] SIGTERM drain: "
+              f"{stats.get('cancelled', 0)} queued sets cancelled, "
+              "in-flight groups finished, completed output emitted.",
               file=sys.stderr)
     return stats

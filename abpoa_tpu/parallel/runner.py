@@ -66,6 +66,16 @@ def lockstep_enabled(abpt: Params) -> bool:
     return has_accelerator()
 
 
+def _default_device(dev):
+    """jax.default_device when a device was picked, no-op otherwise (the
+    split driver and hybrid workers run on the process default)."""
+    if dev is None:
+        import contextlib
+        return contextlib.nullcontext()
+    import jax
+    return jax.default_device(dev)
+
+
 def _lockstep_ok(abpt: Params) -> bool:
     from ..pipeline import plain_route
     from ..align.eligibility import fused_config_eligible
@@ -77,23 +87,31 @@ def _lockstep_ok(abpt: Params) -> bool:
 
 
 def flush_lockstep_group(group: List, abpt: Params, devices: List,
-                         gi: int) -> dict:
+                         gi: int, impl: str = None) -> dict:
     """Run one lockstep group of (idx, ab, seqs, weights) entries; returns
     {idx: Abpoa-with-finished-graph}. Entries absent from the result
     (whole-batch failure, or a per-set device failure) take the sequential
     path. Shared by the `-l` batch segments below and the serve
     coalescer (abpoa_tpu/serve): both pack same-rung read sets into one
-    vmapped dispatch per group."""
+    vmapped dispatch per group.
+
+    impl selects the lockstep implementation (scheduler.lockstep_impl
+    when None): "device" = the all-device vmapped fused loop (real
+    accelerator mesh), "split" = host fusion + batched banded-DP rounds
+    (parallel/lockstep.py — CPU hosts)."""
     if not group:
         return {}
-    import jax
     from ..align.fused_loop import (partition_by_length_bucket,
                                     progressive_poa_fused_batch)
     from ..obs import count, device_capture, observe, trace
+    from . import scheduler
+    from .lockstep import progressive_poa_split_batch
+    if impl is None:
+        impl = scheduler.lockstep_impl(abpt)
     count("lockstep.groups")
     observe("lockstep.group_size", len(group))
     results: dict = {}
-    dev = devices[gi % len(devices)]
+    dev = devices[gi % len(devices)] if devices else None
     outs = []
     flat = []
     # same-Qp-bucket sub-batches keep the shared padding honest (a 100 bp
@@ -104,7 +122,7 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
     from .. import resilience as rz
     backend = "jax" if abpt.device == "tpu" else abpt.device
     with trace.span("lockstep_group", "fused",
-                    args={"k": len(group), "group": gi}), \
+                    args={"k": len(group), "group": gi, "impl": impl}), \
             device_capture("lockstep_group"):
         for sub in partition_by_length_bucket(
                 [(e[0], e[2], e[3], e[1]) for e in group]):
@@ -122,14 +140,26 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
                     continue
                 t0 = time.perf_counter()
                 try:
-                    with jax.default_device(dev):
-                        from ..obs import phase
-                        with phase("align_fused"):
+                    with _default_device(dev):
+                        if impl == "split":
+                            # the split driver times its own align/fusion
+                            # phases and per-read records (phases are a
+                            # partition of wall time by convention)
                             outs.extend(rz.guarded_device_call(
                                 "lockstep_batch", backend,
-                                lambda p=piece: progressive_poa_fused_batch(
+                                lambda p=piece:
+                                progressive_poa_split_batch(
                                     [e[1] for e in p], [e[2] for e in p],
                                     abpt)))
+                        else:
+                            from ..obs import phase
+                            with phase("align_fused"):
+                                outs.extend(rz.guarded_device_call(
+                                    "lockstep_batch", backend,
+                                    lambda p=piece:
+                                    progressive_poa_fused_batch(
+                                        [e[1] for e in p], [e[2] for e in p],
+                                        abpt)))
                 except (rz.DispatchFailed, RuntimeError) as e:
                     print(f"Warning: fused lockstep batch failed ({e}); "
                           "falling back to sequential processing.",
@@ -137,6 +167,8 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
                     count("fallback.lockstep_to_sequential")
                     outs.extend([None] * len(piece))
                     continue
+                if impl == "split":
+                    continue  # per-read records emitted by the driver
                 # amortized per-read SLO records (same contract as
                 # pyapi.msa_batch): the sub-batch wall split evenly across
                 # every read it carried
@@ -183,20 +215,31 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
     from .. import resilience as rz
     from ..obs import metrics as _metrics
     from ..pipeline import Abpoa, msa_from_file, output
+    from . import scheduler
     stats = {"sets": len(files), "quarantined": 0}
     if not (abpt.out_msa or abpt.out_cons or abpt.out_gfa):
         return stats  # mirror msa_from_file: nothing to emit or compute
-    lock = _lockstep_ok(abpt)
-    if not lock and devices is None and len(files) > 1:
-        # CPU-default multi-process set pool (--workers N /
-        # ABPOA_TPU_WORKERS, auto = one worker per core): lockstep loses
-        # throughput on CPU hosts (ROUND8_NOTES.md), so multi-set runs
-        # scale with supervised worker PROCESSES instead — which also
-        # buys crash containment and hard-kill deadlines (pool.py)
-        from .pool import resolve_workers, run_pool_batch
-        n_workers = resolve_workers(abpt, len(files))
-        if n_workers > 1:
-            return run_pool_batch(files, abpt, out_fp, n_workers)
+    route = None
+    if devices is None:
+        # ONE decision site over pool x lockstep x hybrid (scheduler.py);
+        # an explicit `devices` list is a test hook that pins the legacy
+        # in-process routing
+        scheduler.reset()
+        route = scheduler.plan_route(abpt, len(files))
+        if route.kind == "pool":
+            # CPU-default multi-process set pool (--workers N /
+            # ABPOA_TPU_WORKERS, auto = one worker per core): also buys
+            # crash containment and hard-kill deadlines (pool.py)
+            from .pool import run_pool_batch
+            return run_pool_batch(files, abpt, out_fp, route.workers)
+        if route.kind == "hybrid":
+            # pool-of-lockstep-groups: worker processes each running a
+            # split-lockstep group of route.k_cap sets
+            from .pool import run_hybrid_batch
+            return run_hybrid_batch(files, abpt, out_fp, route.workers,
+                                    route.k_cap)
+    lock = route.kind == "lockstep" if route is not None \
+        else _lockstep_ok(abpt)
     # live batch-progress gauges: `abpoa-tpu top` shows sets done / total
     # while the -l run executes (the exporter flusher publishes them)
     _metrics.publish_batch_progress(0, total=len(files))
@@ -254,16 +297,21 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
     from ..align.eligibility import fused_eligible
     from ..io.fastx import read_fastx
     from ..pipeline import _ingest_records
-    K = lockstep_group_size()
+    base_K = route.k_cap if route is not None else lockstep_group_size()
+    K = base_K
     ab_seq = Abpoa()
     seg: List = []    # [(file_idx, fn)] for the current segment
     group: List = []  # [(file_idx, ab, seqs, weights)] eligible subset
     gi = 0
 
     def emit_segment() -> None:
-        nonlocal gi
-        results = flush_lockstep_group(group, abpt, devices, gi)
+        nonlocal gi, K
+        results = flush_lockstep_group(group, abpt, devices, gi,
+                                       impl=route.impl if route else None)
         gi += 1
+        # divergence feedback: measured noop_set_fraction re-caps the NEXT
+        # segment's group size (scheduler.noop_k_cap)
+        K = scheduler.noop_k_cap(base_K)
         for idx, fn in seg:
             if idx in results:
                 abpt.batch_index = idx + 1
@@ -295,6 +343,56 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
             emit_segment()
     emit_segment()
     return stats
+
+
+def run_lockstep_files(pairs, abpt: Params) -> dict:
+    """One lockstep group over `pairs` = [(file_idx, path), ...], outputs
+    captured per file — the hybrid route's unit of work (a pool worker
+    executes this for its group job). Ineligible/failed/quarantined sets
+    take the per-set sequential path with the usual quarantine boundary.
+
+    Returns {"texts": {idx: str}, "quarantined": [idx, ...]}.
+    """
+    import io as _io
+    from .. import resilience as rz
+    from ..align.eligibility import fused_eligible
+    from ..io.fastx import read_fastx
+    from ..pipeline import Abpoa, _ingest_records, msa_from_file, output
+    texts: dict = {}
+    quarantined: list = []
+    group = []
+    for idx, fn in pairs:
+        try:
+            records = read_fastx(fn)
+            rz.validate_records(records, abpt, label=fn)
+            ab = Abpoa()
+            seqs, weights = _ingest_records(ab, abpt, records)
+        except rz.QUARANTINE_EXCEPTIONS as e:
+            rz.quarantine_set(idx, fn, e)
+            quarantined.append(idx)
+            texts[idx] = ""
+            continue
+        if fused_eligible(abpt, len(seqs)):
+            group.append((idx, ab, seqs, weights))
+        # ineligible sets take the per-file sequential path below (they
+        # are simply absent from `results`)
+    results = flush_lockstep_group(group, abpt, None, 0, impl="split")
+    for idx, fn in pairs:
+        if idx in texts and idx not in results:
+            continue  # already quarantined above
+        buf = _io.StringIO()
+        if idx in results:
+            abpt.batch_index = idx + 1
+            output(results[idx], abpt, buf)
+        else:
+            try:
+                abpt.batch_index = idx + 1
+                msa_from_file(Abpoa(), abpt, fn, buf)
+            except rz.QUARANTINE_EXCEPTIONS as e:
+                rz.quarantine_set(idx, fn, e)
+                quarantined.append(idx)
+        texts[idx] = buf.getvalue()
+    return {"texts": texts, "quarantined": sorted(set(quarantined))}
 
 
 def shard_dp_batch(mesh_devices: int = None):

@@ -1,0 +1,148 @@
+"""ONE scheduler over pool x lockstep x hybrid.
+
+Before this module the batch route was ad-hoc: `align/dispatch.py` picked
+kernels, `parallel/runner.py` picked pool-vs-lockstep inline, and
+`serve/server.py` re-derived coalescing eligibility itself. Every consumer
+(the `-l` runner, the serve coalescer, the bench harness) now asks ONE
+decision site, and the decision is recorded (report counters
+`scheduler.<route>` -> Prometheus `abpoa_scheduler_routes_total{route=}`,
+plus a `last route` gauge panel in `abpoa-tpu top`).
+
+Routes:
+
+- **serial**    one set at a time through the single-set engine
+- **pool**      supervised worker processes, one set per job (CPU
+                multicore default — PR 13)
+- **lockstep**  in-process K-set groups; impl "device" = the all-device
+                vmapped fused loop (real accelerator mesh: scatters lower
+                to DMA, the set axis shards 1:1), impl "split" = host
+                fusion + batched banded-DP rounds (parallel/lockstep.py —
+                CPU hosts, where vmapped fusion scatters measured 1.37x
+                slower than serial, ROUND8_NOTES.md)
+- **hybrid**    pool-of-lockstep-groups: worker processes each running a
+                split-lockstep group (explicit --workers N on a multicore
+                host with more sets than one group holds)
+
+The lockstep K cap is fed back from measured divergence: every split
+round reports its idle-lane fraction (`lockstep.noop_set_fraction`), an
+EWMA of which halves the next groups' K per 0.25 of no-op (divergent-
+length sets stop paying for each other's drain).
+"""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+
+class Route(NamedTuple):
+    kind: str       # "serial" | "pool" | "lockstep" | "hybrid"
+    impl: str       # lockstep implementation: "split" | "device" | ""
+    k_cap: int      # sets per lockstep group (lockstep/hybrid)
+    workers: int    # worker processes (pool/hybrid)
+    reason: str
+
+
+# EWMA of the measured idle-lane fraction across lockstep rounds/groups of
+# this run (reset per batch); drives the sub-batch K cap
+_NOOP = {"ewma": 0.0, "seen": False}
+NOOP_HALVING_STEP = 0.25
+
+
+def reset() -> None:
+    _NOOP["ewma"] = 0.0
+    _NOOP["seen"] = False
+
+
+def observe_noop_fraction(f: float) -> None:
+    """Fed by the lockstep drivers each round/group; mirrored to the
+    `abpoa_lockstep_noop_fraction` gauge so `top` can watch the K-cap
+    heuristic's input live."""
+    f = min(max(float(f), 0.0), 1.0)
+    _NOOP["ewma"] = f if not _NOOP["seen"] else (
+        0.5 * _NOOP["ewma"] + 0.5 * f)
+    _NOOP["seen"] = True
+    from ..obs import metrics
+    metrics.publish_noop_fraction(_NOOP["ewma"])
+
+
+def noop_ewma() -> float:
+    return _NOOP["ewma"]
+
+
+def noop_k_cap(base_k: int, noop: Optional[float] = None) -> int:
+    """Sub-batch K cap from measured divergence: each NOOP_HALVING_STEP
+    (0.25) of idle-lane fraction halves the group, floor 1. At 0.5 noop a
+    K=8 group becomes K=2: sets mostly draining alone stop occupying (and
+    waiting on) a wide batch."""
+    f = _NOOP["ewma"] if noop is None else noop
+    k = max(1, int(base_k))
+    while f >= NOOP_HALVING_STEP and k > 1:
+        k //= 2
+        f -= NOOP_HALVING_STEP
+    return k
+
+
+def _explicit_workers(abpt) -> int:
+    """Operator-requested worker count (pool.explicit_workers — ONE
+    grammar for the --workers/env knob), 0 if unset. Hybrid requires the
+    explicit opt-in for the same reason pool auto never forks
+    device-family backends: N workers = N accelerator clients."""
+    from .pool import explicit_workers
+    return explicit_workers(abpt)
+
+
+def lockstep_impl(abpt) -> str:
+    """Which lockstep implementation fits this host: the all-device vmapped
+    fused loop needs real accelerator silicon (scatters lower to DMA, the
+    set axis shards across chips); on CPU hosts the split driver wins
+    (ROUND8_NOTES.md / PERF.md round 14). ABPOA_TPU_LOCKSTEP_IMPL
+    overrides for measurement."""
+    forced = os.environ.get("ABPOA_TPU_LOCKSTEP_IMPL", "").strip().lower()
+    if forced in ("split", "device"):
+        return forced
+    from ..utils.probe import has_accelerator
+    return "device" if has_accelerator() else "split"
+
+
+def plan_route(abpt, n_sets: int, serve: bool = False) -> Route:
+    """THE batch/serve dispatch decision: device inventory (accelerator vs
+    CPU, core count via pool.resolve_workers), lockstep eligibility
+    (config scope + opt-in), and the noop-fraction K cap, in one place.
+
+    serve=True plans the coalescing path: pool-vs-serial is the server's
+    own --pool-workers knob, so only serial/lockstep come back.
+    """
+    from .runner import _lockstep_ok, lockstep_group_size
+    route = _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size)
+    from ..obs import count, metrics
+    count(f"scheduler.{route.kind}")
+    metrics.publish_route(route)
+    return route
+
+
+def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size) -> Route:
+    if n_sets <= 0:
+        return Route("serial", "", 1, 1, "empty batch")
+    if not _lockstep_ok(abpt):
+        if serve:
+            return Route("serial", "", 1, 1, "lockstep ineligible")
+        from .pool import resolve_workers
+        w = resolve_workers(abpt, n_sets)
+        if w > 1 and n_sets > 1:
+            return Route("pool", "", 1, w,
+                         f"{w} workers over {n_sets} sets (CPU multicore)")
+        return Route("serial", "", 1, 1,
+                     "single set/core, or lockstep ineligible")
+    impl = lockstep_impl(abpt)
+    base_k = lockstep_group_size()
+    k_cap = noop_k_cap(base_k)
+    reason = f"impl={impl} k_cap={k_cap}"
+    if k_cap != base_k:
+        reason += f" (noop ewma {_NOOP['ewma']:.2f} capped {base_k})"
+    if not serve and impl == "split":
+        w = _explicit_workers(abpt)
+        if w > 1 and n_sets > k_cap:
+            groups = -(-n_sets // k_cap)
+            return Route("hybrid", impl, k_cap, min(w, groups),
+                         reason + f" x {min(w, groups)} group workers")
+    return Route("lockstep", impl, k_cap, 1, reason)

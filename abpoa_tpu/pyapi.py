@@ -310,6 +310,14 @@ class msa_aligner:
         if lockstep:
             from .align.fused_loop import (partition_by_length_bucket,
                                            progressive_poa_fused_batch)
+            from .parallel import scheduler
+            from .parallel.lockstep import progressive_poa_split_batch
+            # the scheduler's lockstep implementation pick (ONE decision
+            # site with the -l/serve paths): all-device vmapped groups on
+            # real accelerator meshes, split host-fusion driver on hosts
+            impl = scheduler.lockstep_impl(abpt)
+            drv = (progressive_poa_fused_batch if impl == "device"
+                   else progressive_poa_split_batch)
             order, outs = [], []
             # same-Qp-bucket sub-batches; a failed bucket falls back alone.
             # The outer device_capture makes the whole msa_batch ONE XProf
@@ -336,16 +344,23 @@ class msa_aligner:
                             outs.extend([None] * len(piece))
                             continue
                         t0 = time.perf_counter()
+                        # the split driver times its own align/fusion
+                        # phases and per-read records; only the all-device
+                        # chunk gets the blanket align_fused phase
+                        import contextlib
+                        ph = (obs.phase("align_fused") if impl == "device"
+                              else contextlib.nullcontext())
                         try:
-                            with obs.phase("align_fused"):
+                            with ph:
                                 outs.extend(rz.guarded_device_call(
                                     "msa_batch", backend,
-                                    lambda p=piece:
-                                    progressive_poa_fused_batch(
+                                    lambda p=piece: drv(
                                         [e[1] for e in p],
                                         [e[2] for e in p], abpt)))
                         except (rz.DispatchFailed, RuntimeError):
                             outs.extend([None] * len(piece))
+                            continue
+                        if impl != "device":
                             continue
                         # amortized per-read SLO records: the sub-batch
                         # wall split evenly across every read it carried

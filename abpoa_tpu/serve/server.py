@@ -140,6 +140,7 @@ class AlignServer:
         self._n_workers = max(1, workers)
         self._devices = None        # jax devices, set after warm
         self._lockstep = False
+        self._lockstep_impl = ""    # "split" | "device" once routed
         import itertools
         self._group_ids = itertools.count()  # atomic across workers
         self.t_start = time.time()
@@ -184,12 +185,14 @@ class AlignServer:
                           file=sys.stderr)
                 import jax
                 self._devices = jax.devices()
-                from ..align.eligibility import fused_config_eligible
-                from ..parallel import lockstep_enabled
-                from ..pipeline import plain_route
-                self._lockstep = (lockstep_enabled(self.abpt)
-                                  and plain_route(self.abpt)
-                                  and fused_config_eligible(self.abpt))
+                # ONE decision site with the -l batch path: the scheduler
+                # plans whether coalesced groups form and which lockstep
+                # implementation runs them (parallel/scheduler.py)
+                from ..parallel import lockstep_group_size, plan_route
+                route = plan_route(self.abpt, lockstep_group_size(),
+                                   serve=True)
+                self._lockstep = route.kind == "lockstep"
+                self._lockstep_impl = route.impl
             else:
                 print("[abpoa-tpu serve] Warning: JAX backend probe timed "
                       "out; serving on the host engine.", file=sys.stderr)
@@ -281,8 +284,12 @@ class AlignServer:
     # ---------------------------------------------------------- execution
     def _worker_loop(self) -> None:
         from ..parallel import lockstep_group_size
-        max_k = lockstep_group_size() if self._lockstep else 1
+        from ..parallel import scheduler as _sched
+        base_k = lockstep_group_size() if self._lockstep else 1
         while True:
+            # divergence feedback: measured noop_set_fraction re-caps the
+            # next coalesced group's K (scheduler.noop_k_cap)
+            max_k = (_sched.noop_k_cap(base_k) if self._lockstep else 1)
             group = self.admission.next_group(max_k=max_k,
                                               coalesce=self._lockstep)
             if not group:
@@ -482,8 +489,9 @@ class AlignServer:
             return
         try:
             results = call_with_deadline(
-                lambda: flush_lockstep_group(entries, abpt, self._devices,
-                                             gi),
+                lambda: flush_lockstep_group(
+                    entries, abpt, self._devices, gi,
+                    impl=self._lockstep_impl or None),
                 deadline_s=deadline, label=f"serve_group:{gi}")
         except DispatchTimeout:
             for i, *_ in entries:

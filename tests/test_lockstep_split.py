@@ -1,0 +1,339 @@
+"""Dispatch-layer rewrite tests (round 14).
+
+- structural jaxpr scatter budget: `_fuse_vectorized` lowers to <= 4
+  scatter sites (the collapse can't silently regress)
+- spill-scatter convention drift test (fused_loop.spill_scatter)
+- split-lockstep parity: collapsed-scatter fused path AND the split
+  lockstep driver are byte-identical to the numpy oracle across the
+  linear/affine/convex kernel grid and K in {1, 2, 4} with
+  divergent-length sets (born-finished padding included)
+- scheduler unit behavior: route kinds + the noop-fraction K cap
+- scheduler/noop Prometheus families + `top` panel rendering
+"""
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.conftest import DATA_DIR  # noqa: E402
+
+from abpoa_tpu.params import Params  # noqa: E402
+
+
+def _params(device="jax", **kw):
+    abpt = Params()
+    abpt.device = device
+    for k, v in kw.items():
+        setattr(abpt, k, v)
+    abpt.finalize()
+    return abpt
+
+
+def _random_sets(rng, sizes, qlen_lo=40, qlen_hi=200, err=0.12):
+    """Divergent-length read sets: set i has sizes[i] reads of a mutated
+    common reference whose length differs per set."""
+    sets, wsets = [], []
+    for i, n in enumerate(sizes):
+        L = int(rng.integers(qlen_lo, qlen_hi))
+        ref = rng.integers(0, 4, L).astype(np.uint8)
+        reads = []
+        for _ in range(n):
+            r = ref.copy()
+            n_mut = max(1, int(err * L))
+            posn = rng.integers(0, L, n_mut)
+            r[posn] = rng.integers(0, 4, n_mut)
+            reads.append(r)
+        sets.append(reads)
+        wsets.append([np.ones(len(r), dtype=np.int64) for r in reads])
+    return sets, wsets
+
+
+def _host_graph_consensus(abpt_kw, seqs, weights):
+    from abpoa_tpu.cons.consensus import generate_consensus
+    from abpoa_tpu.io.output import output_fx_consensus
+    from abpoa_tpu.pipeline import Abpoa, poa
+    abpt = _params(device="numpy", **abpt_kw)
+    ab = Abpoa()
+    for r in seqs:
+        ab.append_read(seq="x" * len(r))
+    poa(ab, abpt, seqs, weights, 0)
+    cons = generate_consensus(ab.graph, abpt, len(seqs))
+    out = io.StringIO()
+    output_fx_consensus(cons, abpt, out)
+    return out.getvalue()
+
+
+def _split_consensus(abpt_kw, seq_sets, weight_sets):
+    from abpoa_tpu.cons.consensus import generate_consensus
+    from abpoa_tpu.io.output import output_fx_consensus
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    abpt = _params(device="jax", **abpt_kw)
+    outs = progressive_poa_split_batch(seq_sets, weight_sets, abpt)
+    texts = []
+    for i, o in enumerate(outs):
+        assert o is not None, f"set {i} fell back"
+        pg, _is_rc = o
+        cons = generate_consensus(pg, abpt, len(seq_sets[i]))
+        buf = io.StringIO()
+        output_fx_consensus(cons, abpt, buf)
+        texts.append(buf.getvalue())
+    return texts
+
+
+# --------------------------------------------------------------------- #
+# structural scatter budget                                             #
+# --------------------------------------------------------------------- #
+
+def _count_scatters(jaxpr, counts):
+    import jax
+    for eq in jaxpr.eqns:
+        if eq.primitive.name.startswith("scatter"):
+            counts[eq.primitive.name] = counts.get(eq.primitive.name, 0) + 1
+        for v in eq.params.values():
+            if hasattr(v, "jaxpr"):
+                _count_scatters(v.jaxpr, counts)
+            if isinstance(v, (list, tuple)):
+                for vv in v:
+                    if hasattr(vv, "jaxpr"):
+                        _count_scatters(vv.jaxpr, counts)
+    return counts
+
+
+def test_fuse_vectorized_scatter_budget():
+    """The tentpole pin: _fuse_vectorized lowers to <= 4 scatter sites
+    (path plane, out-adjacency, in-adjacency, aligned-group). A fifth
+    scatter creeping back in is the regression this guards against."""
+    import jax
+    import jax.numpy as jnp
+    from abpoa_tpu.align.fused_loop import _fuse_vectorized, init_fused_state
+    T, Qp = 64, 64
+    st = init_fused_state(256, 8, 8)
+    jx = jax.make_jaxpr(_fuse_vectorized)(
+        st.g, jnp.zeros(T, jnp.int32), jnp.zeros(T, jnp.int32),
+        jnp.int32(10), jnp.zeros(Qp, jnp.int32), jnp.int32(10),
+        jnp.ones(Qp, jnp.int32))
+    counts = _count_scatters(jx.jaxpr, {})
+    assert sum(counts.values()) <= 4, counts
+
+
+def test_fuse_vectorized_scatter_budget_vmapped():
+    """The budget must hold under vmap too (the on-chip lockstep shape):
+    batching must not multiply scatter sites."""
+    import jax
+    import jax.numpy as jnp
+    from abpoa_tpu.align.fused_loop import _fuse_vectorized, init_fused_state
+
+    K, T, Qp = 4, 64, 64
+    st = init_fused_state(256, 8, 8)
+    gK = jax.tree.map(lambda x: jnp.stack([x] * K), st.g)
+    jx = jax.make_jaxpr(jax.vmap(_fuse_vectorized))(
+        gK, jnp.zeros((K, T), jnp.int32), jnp.zeros((K, T), jnp.int32),
+        jnp.zeros(K, jnp.int32), jnp.zeros((K, Qp), jnp.int32),
+        jnp.zeros(K, jnp.int32), jnp.ones((K, Qp), jnp.int32))
+    counts = _count_scatters(jx.jaxpr, {})
+    assert sum(counts.values()) <= 4, counts
+
+
+def test_spill_scatter_convention():
+    """The hoisted extra-slot convention: invalid rows drop, valid rows
+    land, for every op flavor, 1-D and N-D operands — the drift test for
+    the sites that share fused_loop.spill_scatter."""
+    import jax.numpy as jnp
+    from abpoa_tpu.align.fused_loop import spill_scatter
+    arr = jnp.zeros(4, jnp.int32)
+    idx = jnp.asarray([0, 1, 2, 3])
+    valid = jnp.asarray([True, False, True, False])
+    vals = jnp.asarray([5, 6, 7, 8], jnp.int32)
+    out = np.asarray(spill_scatter(arr, idx, valid, vals))
+    assert out.tolist() == [5, 0, 7, 0]
+    # add-op accumulates only valid rows, even with duplicate indices
+    out = np.asarray(spill_scatter(arr, jnp.asarray([2, 2, 2, 2]),
+                                   valid, vals, op="add"))
+    assert out.tolist() == [0, 0, 12, 0]
+    # out-of-range VALID index also drops (the N+1 semantics): an index
+    # equal to len(arr) routes to the appended spill slot
+    out = np.asarray(spill_scatter(arr, jnp.asarray([4, 0, 4, 1]),
+                                   jnp.ones(4, bool), vals))
+    assert out.tolist() == [6, 8, 0, 0]
+    # 2-D rows
+    arr2 = jnp.zeros((3, 2), jnp.int32)
+    vals2 = jnp.ones((2, 2), jnp.int32)
+    out = np.asarray(spill_scatter(arr2, jnp.asarray([1, 2]),
+                                   jnp.asarray([True, False]), vals2))
+    assert out.tolist() == [[0, 0], [1, 1], [0, 0]]
+
+
+# --------------------------------------------------------------------- #
+# split-lockstep parity                                                 #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("kw", [
+    {},                                    # convex
+    {"gap_open2": 0},                      # affine
+    {"gap_open1": 0, "gap_open2": 0},      # linear
+], ids=["convex", "affine", "linear"])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_split_lockstep_parity_grid(kw, k):
+    """Split-lockstep output byte-identical to the numpy oracle across
+    the kernel grid and K in {1,2,4}, with divergent-length sets (set
+    sizes differ, so sets finish at different rounds and the survivors
+    ride born-finished padding lanes)."""
+    rng = np.random.default_rng(123 + k)
+    sizes = [3, 6, 2, 5][:k]
+    seq_sets, weight_sets = _random_sets(rng, sizes)
+    got = _split_consensus(kw, seq_sets, weight_sets)
+    for i in range(k):
+        want = _host_graph_consensus(kw, seq_sets[i], weight_sets[i])
+        assert got[i] == want, f"set {i} diverged (K={k}, {kw})"
+
+
+def test_split_lockstep_data_files():
+    """Shipped data files as one divergent 3-set group vs the host loop."""
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records
+    abpt = _params()
+    seq_sets, weight_sets = [], []
+    for fn in ("seq.fa", "test.fa", "heter.fa"):
+        seqs, weights = _ingest_records(
+            Abpoa(), abpt, read_fastx(os.path.join(DATA_DIR, fn)))
+        seq_sets.append(seqs)
+        weight_sets.append(weights)
+    got = _split_consensus({}, seq_sets, weight_sets)
+    for i in range(3):
+        want = _host_graph_consensus({}, seq_sets[i], weight_sets[i])
+        assert got[i] == want
+
+
+def test_split_lockstep_amb_strand():
+    """Ambiguous-strand rescue inside the split driver: rc reads are
+    realigned in the extra batched dispatch and annotated, byte-matching
+    the host loop (which must actually flip at least one read)."""
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records, poa
+    path = os.path.join(DATA_DIR, "rcmix.fa")
+    abpt = _params(amb_strand=1)
+    seqs, weights = _ingest_records(Abpoa(), abpt, read_fastx(path))
+    outs = progressive_poa_split_batch([seqs, seqs], [weights, weights],
+                                       abpt)
+    abpt_h = _params(device="numpy", amb_strand=1)
+    ab = Abpoa()
+    for r in seqs:
+        ab.append_read(seq="x" * len(r))
+    poa(ab, abpt_h, seqs, weights, 0)
+    assert any(ab.is_rc), "fixture no longer exercises the rc path"
+    for o in outs:
+        assert o is not None
+        _pg, is_rc = o
+        assert is_rc == ab.is_rc
+
+
+def test_split_lockstep_via_run_batch(tmp_path):
+    """`-l` end to end: device=jax + --lockstep on routes through the
+    scheduler to the split driver on this CPU host, and the emitted bytes
+    match the serial numpy runner exactly."""
+    from abpoa_tpu.parallel import run_batch
+    from abpoa_tpu.parallel import scheduler
+    files = [os.path.join(DATA_DIR, f)
+             for f in ("seq.fa", "test.fa", "heter.fa")]
+
+    abpt = _params(device="numpy")
+    want = io.StringIO()
+    run_batch(files, abpt, want, devices=[None])
+
+    abpt = _params(device="jax", lockstep="on")
+    scheduler.reset()
+    route = scheduler.plan_route(abpt, len(files))
+    assert route.kind == "lockstep" and route.impl == "split"
+    got = io.StringIO()
+    run_batch(files, abpt, got)
+    assert got.getvalue() == want.getvalue()
+
+
+# --------------------------------------------------------------------- #
+# scheduler                                                             #
+# --------------------------------------------------------------------- #
+
+def test_scheduler_noop_k_cap():
+    from abpoa_tpu.parallel.scheduler import noop_k_cap
+    assert noop_k_cap(8, 0.0) == 8
+    assert noop_k_cap(8, 0.24) == 8
+    assert noop_k_cap(8, 0.25) == 4
+    assert noop_k_cap(8, 0.5) == 2
+    assert noop_k_cap(8, 0.75) == 1
+    assert noop_k_cap(8, 1.0) == 1
+    assert noop_k_cap(1, 0.9) == 1
+
+
+def test_scheduler_routes(monkeypatch):
+    from abpoa_tpu.parallel import scheduler
+    scheduler.reset()
+    # host device -> lockstep ineligible -> serial on this 1-core host
+    abpt = _params(device="numpy")
+    r = scheduler.plan_route(abpt, 4)
+    assert r.kind in ("serial", "pool")
+    # explicit workers make multi-set host batches a pool
+    abpt.workers = 3
+    r = scheduler.plan_route(abpt, 4)
+    assert r.kind == "pool" and r.workers == 3
+    # lockstep opt-in on a CPU host -> split lockstep
+    abpt = _params(device="jax", lockstep="on")
+    r = scheduler.plan_route(abpt, 4)
+    assert r.kind == "lockstep" and r.impl == "split"
+    # measured divergence caps K
+    scheduler.reset()
+    scheduler.observe_noop_fraction(0.6)
+    r2 = scheduler.plan_route(abpt, 4)
+    assert r2.k_cap < r.k_cap
+    # explicit workers + many sets -> hybrid (pool of lockstep groups)
+    scheduler.reset()
+    abpt.workers = 2
+    r = scheduler.plan_route(abpt, 32)
+    assert r.kind == "hybrid" and r.workers == 2 and r.k_cap >= 1
+    abpt.workers = 0
+    # forced impl override
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP_IMPL", "device")
+    r = scheduler.plan_route(abpt, 4)
+    assert r.impl == "device"
+    scheduler.reset()
+
+
+def test_scheduler_metrics_and_top_panel():
+    """Route decisions + noop EWMA surface as Prometheus families, lint
+    clean, and render in the `top` scheduler panel."""
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.obs.top import render_frame
+    from abpoa_tpu.parallel import scheduler
+    M.reset_registry()
+    scheduler.reset()
+    abpt = _params(device="jax", lockstep="on")
+    scheduler.observe_noop_fraction(0.5)
+    route = scheduler.plan_route(abpt, 4)
+    assert route.kind == "lockstep"
+    text = M.registry().render()
+    assert not M.lint_exposition(text), M.lint_exposition(text)
+    samples, types = M.parse_exposition(text)
+    assert M.sample_value(samples, "abpoa_scheduler_routes_total",
+                          route="lockstep") == 1
+    assert M.sample_value(samples, "abpoa_lockstep_noop_fraction") == 0.5
+    assert M.sample_value(samples, "abpoa_scheduler_route",
+                          route="lockstep") == 1
+    assert M.sample_value(samples, "abpoa_scheduler_k_cap") == route.k_cap
+    frame = render_frame(samples, types, "test.prom", 0.0)
+    assert "sched" in frame and "route lockstep" in frame
+    assert "noop 0.50" in frame
+    scheduler.reset()
+
+
+def test_run_dp_chunk_warmable():
+    """The new ladder entry warms: the quick-tier anchor precompiles the
+    (R, K) grid the CI micro-run hits, through the same dispatch helper
+    the driver uses."""
+    from abpoa_tpu.compile.ladder import LADDER, QUICK_TIER
+    assert "run_dp_chunk" in LADDER
+    assert any(a.entry == "run_dp_chunk" for a in QUICK_TIER)

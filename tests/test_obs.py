@@ -115,7 +115,13 @@ def test_lockstep_report_counters():
     assert rep["counters"]["lockstep.chunks"] >= 1
     assert rep["values"]["lockstep.k"]["max"] == 2
     assert "lockstep.noop_set_fraction" in rep["values"]
-    assert "align_fused" in rep["phases"]
+    from abpoa_tpu.parallel import scheduler
+    if scheduler.lockstep_impl(abpt) == "device":
+        # all-device vmapped groups: one fused phase covers DP + fusion
+        assert "align_fused" in rep["phases"]
+    else:
+        # split driver (round 14): DP and host fusion attributed apart
+        assert "align" in rep["phases"] and "fusion" in rep["phases"]
     assert rep["counters"]["dp.cells"] > 0
 
 

@@ -1,20 +1,27 @@
 #!/usr/bin/env python
-"""Lockstep K-scaling on the 8-way virtual CPU mesh (ROADMAP item 3's
-no-tunnel half).
+"""Lockstep K-scaling on this CPU host (ROADMAP item 2's measurement).
 
 For K in {1, 4, 8}: K independent read sets (n reads x ref-len each,
-distinct seeds) advance through the fused progressive loop as ONE vmapped
-dispatch per chunk, the set axis sharded over min(K, 8) virtual CPU
-devices. Reports warm reads/s per K and the scaling ratio vs K=1, judged
-against PERF.md's decision rule: warm reads/s scaling >= 0.7*K means
-lockstep is the product default for `-l`-shaped workloads; worse means
-the vmapped fusion scatter (fused_loop.py) is the suspect and per-chip
-process parallelism over sets is the fallback.
+distinct seeds) run through the SCHEDULER-selected lockstep driver
+(parallel/scheduler.py -> the split driver on CPU hosts: host fusion +
+batched banded-DP rounds, round 14). K=1 is the serial baseline: the
+single-set all-device fused loop, the path a plain run takes. Reports
+warm reads/s per K, the scaling ratio vs serial K=1, and the scheduler
+route per row.
+
+Decision rules:
+- host rule (this bench): K=4 aggregate reads/s >= 1.0x the serial K=1
+  path — lockstep must never LOSE throughput vs running the sets
+  back-to-back (round 8 measured 0.73x for the all-device vmapped
+  lockstep; the round-14 dispatch rewrite is gated on beating 1.0x).
+- the 0.7*K rule stays the ON-CHIP gate for the all-device lockstep
+  (ROADMAP item 3): scaling >= 0.7*K on a real accelerator mesh keeps
+  lockstep the `-l` default there.
 
 Writes BENCH_lockstep_cpu.json (one dict per K + the verdict). Run from
 the repo root:
 
-    python tools/bench_lockstep_cpu.py [--n-reads 10] [--ref-len 10000]
+    python tools/bench_lockstep_cpu.py --n-reads 10 --ref-len 2000
 """
 from __future__ import annotations
 
@@ -29,8 +36,6 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
 
@@ -47,7 +52,7 @@ def _sim(path: str, n_reads: int, ref_len: int, seed: int) -> str:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-reads", type=int, default=10)
-    ap.add_argument("--ref-len", type=int, default=10000)
+    ap.add_argument("--ref-len", type=int, default=2000)
     ap.add_argument("--ks", type=int, nargs="+", default=[1, 4, 8])
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "BENCH_lockstep_cpu.json"))
@@ -55,16 +60,17 @@ def main() -> int:
 
     import jax
     jax.config.update("jax_platforms", "cpu")
-    import numpy as np
-    from jax.sharding import Mesh
     from abpoa_tpu import obs
-    from abpoa_tpu.align.fused_loop import progressive_poa_fused_batch
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused
     from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.parallel import scheduler
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
     from abpoa_tpu.params import Params
     from abpoa_tpu.pipeline import Abpoa, _ingest_records
 
     abpt = Params()
     abpt.device = "jax"
+    abpt.lockstep = "on"
     abpt.finalize()
 
     all_sets, all_wsets = [], []
@@ -76,39 +82,58 @@ def main() -> int:
         all_sets.append(seqs)
         all_wsets.append(weights)
 
+    def run_k(k: int):
+        """(outs, route_dict): K=1 serial fused baseline, K>1 through the
+        scheduler-selected lockstep driver."""
+        sets, wsets = all_sets[:k], all_wsets[:k]
+        if k == 1:
+            pg, _, is_rc = progressive_poa_fused(sets[0], wsets[0], abpt)
+            return [(pg, is_rc)], {"kind": "serial", "impl": "fused",
+                                   "k_cap": 1}
+        scheduler.reset()
+        route = scheduler.plan_route(abpt, k)
+        impl = route.impl or "split"
+        if impl == "split":
+            outs = progressive_poa_split_batch(sets, wsets, abpt)
+        else:
+            from abpoa_tpu.align.fused_loop import (
+                progressive_poa_fused_batch)
+            outs = progressive_poa_fused_batch(sets, wsets, abpt)
+        return outs, {"kind": route.kind, "impl": impl,
+                      "k_cap": route.k_cap}
+
     rows = []
     base_rps = None
     for k in args.ks:
-        devs = np.array(jax.devices()[: min(k, 8)])
-        mesh = Mesh(devs, ("set",)) if len(devs) > 1 else None
-        sets, wsets = all_sets[:k], all_wsets[:k]
         # cold pass: compiles (persistent-cache assisted) + execution
         t0 = time.perf_counter()
-        outs = progressive_poa_fused_batch(sets, wsets, abpt, mesh=mesh)
+        outs, route = run_k(k)
         cold = time.perf_counter() - t0
         obs.start_run()
         t0 = time.perf_counter()
-        outs = progressive_poa_fused_batch(sets, wsets, abpt, mesh=mesh)
+        outs, route = run_k(k)
         warm = time.perf_counter() - t0
         rep = obs.finalize_report()
         ok = sum(o is not None for o in outs)
         rps = k * args.n_reads / warm
         row = {
-            "k": k, "mesh_devices": len(devs), "sets_ok": ok,
+            "k": k, "route": route, "sets_ok": ok,
             "n_reads": args.n_reads, "ref_len": args.ref_len,
             "cold_wall_s": round(cold, 3), "warm_wall_s": round(warm, 3),
             "reads_per_sec": round(rps, 3),
             "scaling_vs_k1": None,
             "counters": {c: v for c, v in rep["counters"].items()
-                         if c.startswith(("lockstep.", "fused."))},
+                         if c.startswith(("lockstep.", "fused.",
+                                          "scheduler."))},
         }
         if base_rps is None:
             base_rps = rps
         else:
             row["scaling_vs_k1"] = round(rps / base_rps, 3)
         rows.append(row)
-        print(f"[lockstep-cpu] K={k}: warm {warm:.2f}s, {rps:.2f} reads/s"
-              + (f", scaling {row['scaling_vs_k1']}x (rule >= {0.7 * k:.1f})"
+        print(f"[lockstep-cpu] K={k} route={route['kind']}/{route['impl']}: "
+              f"warm {warm:.2f}s, {rps:.2f} reads/s"
+              + (f", {row['scaling_vs_k1']}x vs serial"
                  if row["scaling_vs_k1"] else ""), file=sys.stderr)
 
     verdict = {}
@@ -116,13 +141,14 @@ def main() -> int:
         if row["scaling_vs_k1"] is not None:
             verdict[f"k{row['k']}"] = {
                 "scaling": row["scaling_vs_k1"],
-                "rule": round(0.7 * row["k"], 2),
-                "pass": row["scaling_vs_k1"] >= 0.7 * row["k"],
+                "host_rule": 1.0,
+                "pass": row["scaling_vs_k1"] >= 1.0,
             }
     out = {
-        "bench": "lockstep_k_scaling_cpu_mesh",
-        "host": "8-way virtual CPU mesh (xla_force_host_platform_device_count)",
-        "decision_rule": "warm reads/s scaling >= 0.7*K (PERF.md)",
+        "bench": "lockstep_k_scaling_cpu",
+        "host": "single-core CPU container (scheduler-routed)",
+        "decision_rule": ("host: aggregate reads/s >= 1.0x serial K=1; "
+                          "0.7*K stays the on-chip gate (ROADMAP item 3)"),
         "rows": rows,
         "verdict": verdict,
     }

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Lockstep scheduler-invariant gate (CI: perf-gate job, smoke scale).
+
+The round-14 dispatch rewrite is held to one invariant on EVERY host:
+lockstep must never LOSE throughput against running the same sets
+serially (round 8 measured the all-device vmapped lockstep at 0.73x —
+the regression this gate pins down forever). At smoke scale:
+
+- 4 sets of 20 x 2 kb reads — the bench protocol's read length (the
+  crossover where batched DP rounds beat the single-dispatch fused loop
+  sits near ~1.5 kb on one core: below it, per-round dispatch overhead
+  dominates and the scheduler's serial route is the right call), at the
+  quick warm tier's 2.2 kb fused anchor shape (reads rung 32) so a
+  warmed cache serves the serial baseline too
+- serial baseline: the 4 sets back-to-back through the single-set fused
+  path (what a plain run does)
+- lockstep: ONE scheduler-routed `--lockstep on` K=4 group (the split
+  driver on CPU hosts)
+- gate 1: lockstep aggregate reads/s >= 1.0x serial (warm walls)
+- gate 2: the TIMED lockstep run reports ZERO compile misses — the
+  in-run recompile budget (perf_gate semantics): after the warm pass,
+  a run that still compiles mid-flight has cache-key instability or an
+  off-ladder shape drift. (CI's preceding `warm --ladder quick` step
+  covers the same rungs via the run_dp_chunk anchor at qmax=2200, so
+  even the warm pass is persistent-cache loads there.)
+
+Exits 0 on pass, 1 on an invariant violation. --inject-slowdown F (test
+hook) divides the measured lockstep throughput by F to prove the flip.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+
+K, N_READS, REF_LEN = 4, 20, 2000
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    metavar="F", help="divide lockstep reads/s by F "
+                    "(test hook proving the gate flips)")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from abpoa_tpu import obs
+    from abpoa_tpu.align.fused_loop import progressive_poa_fused
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.parallel import scheduler
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records
+
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.lockstep = "on"
+    abpt.finalize()
+
+    sets, wsets = [], []
+    for s in range(K):
+        p = os.path.join("/tmp", f"lockstep_gate_{N_READS}x{REF_LEN}.{s}.fa")
+        if not os.path.isfile(p):
+            subprocess.run(
+                [sys.executable, os.path.join(REPO, "tests", "make_sim.py"),
+                 "--ref-len", str(REF_LEN), "--n-reads", str(N_READS),
+                 "--err", "0.1", "--seed", str(800 + s), "--out", p],
+                check=True)
+        seqs, weights = _ingest_records(Abpoa(), abpt, read_fastx(p))
+        sets.append(seqs)
+        wsets.append(weights)
+
+    def serial_once():
+        for s in range(K):
+            progressive_poa_fused(sets[s], wsets[s], abpt)
+
+    def lockstep_once():
+        outs = progressive_poa_split_batch(sets, wsets, abpt)
+        assert all(o is not None for o in outs), "split set fell back"
+
+    scheduler.reset()
+    route = scheduler.plan_route(abpt, K)
+    print(f"[lockstep-gate] route: {route.kind}/{route.impl} "
+          f"k_cap={route.k_cap}", file=sys.stderr)
+
+    # warm pass (compiles / persistent-cache loads), then timed passes
+    serial_once()
+    lockstep_once()
+    t0 = time.perf_counter()
+    serial_once()
+    serial_wall = time.perf_counter() - t0
+    obs.start_run()
+    t0 = time.perf_counter()
+    lockstep_once()
+    lock_wall = time.perf_counter() - t0
+    rep = obs.finalize_report()
+    misses = int((rep.get("compiles") or {}).get("misses") or 0)
+
+    reads = K * N_READS
+    serial_rps = reads / serial_wall
+    lock_rps = reads / lock_wall
+    if args.inject_slowdown:
+        lock_rps /= args.inject_slowdown
+        print(f"[lockstep-gate] injected {args.inject_slowdown}x slowdown "
+              "(test hook)", file=sys.stderr)
+    ratio = lock_rps / serial_rps
+    print(f"[lockstep-gate] serial {serial_wall:.2f}s ({serial_rps:.1f} r/s)"
+          f"  lockstep K={K} {lock_wall:.2f}s ({lock_rps:.1f} r/s)"
+          f"  ratio {ratio:.2f}x  compile_misses {misses}",
+          file=sys.stderr)
+    rc = 0
+    if ratio < 1.0:
+        print(f"[lockstep-gate] FAIL: lockstep K={K} {ratio:.2f}x < 1.0x "
+              "serial — the scheduler invariant is violated "
+              "(ROUND8_NOTES.md regression)", file=sys.stderr)
+        rc = 1
+    if misses > 0:
+        print(f"[lockstep-gate] FAIL: warm lockstep run compiled in-flight "
+              f"({misses} misses) — cache-key instability or a shape "
+              "drifting off the run_dp_chunk ladder (compile/ladder.py)",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("[lockstep-gate] PASS", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
