@@ -280,6 +280,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["slo"]:
         from .obs.slo import slo_main
         return slo_main(raw[1:])
+    if raw[:1] == ["why"]:
+        from .obs.why import why_main
+        return why_main(raw[1:])
     if raw[:1] == ["top"]:
         from .obs.top import top_main
         return top_main(raw[1:])

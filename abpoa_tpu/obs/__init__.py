@@ -6,19 +6,25 @@ hooks — and, above the per-run layer, the fleet-grade metric registry
 (metrics.py: streaming-quantile sketches, Prometheus exposition), the
 cross-run report archive (archive.py) and SLO/error-budget evaluation
 (slo.py, `abpoa-tpu slo`) plus the live `abpoa-tpu top` dashboard
-(top.py). See report.py for the schema, trace.py for the timeline
+(top.py) — and, since PR 15, cross-process request tracing (trace.py
+request context + per-request export), the pool-worker flight recorder
+(flight.py) and the `abpoa-tpu why` postmortem analyzer (why.py).
+See report.py for the schema, trace.py for the timeline
 contract, compile_log.py for compile detection, mfu.py for the model's
 assumptions, capture.py for the `--profile-dir` hooks; README
-"Run telemetry" / "Metrics & SLOs" and PERF.md document the consumer
-side (bench.py, perf_gate, chip_watcher, CI metrics-smoke)."""
-from . import archive, metrics, trace
+"Run telemetry" / "Metrics & SLOs" / "Observability" and PERF.md
+document the consumer side (bench.py, perf_gate, chip_watcher, CI
+metrics-smoke / serve-smoke)."""
+from . import archive, flight, metrics, trace
 from .capture import device_capture, profile_dir, set_profile_dir
 from .compile_log import compile_watch
 from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
                      finalize_report, observe, phase, record_dp, record_fault,
                      record_read, render_report, render_report_diff, report,
                      set_enabled, start_run, summary, write_report)
-from .trace import (export_chrome_trace, instant, span, span_totals, tracer)
+from .trace import (export_chrome_trace, export_request_trace, instant,
+                    new_request_id, request_ctx, sampled, span, span_totals,
+                    tracer)
 from .trace import disable as trace_disable
 from .trace import enable as trace_enable
 from .trace import enabled as trace_enabled
@@ -32,6 +38,7 @@ __all__ = [
     "device_capture", "profile_dir", "set_profile_dir",
     "trace", "trace_enable", "trace_disable", "trace_enabled",
     "span", "instant", "span_totals", "export_chrome_trace", "tracer",
+    "new_request_id", "request_ctx", "sampled", "export_request_trace",
     "compile_watch",
-    "archive", "metrics",
+    "archive", "flight", "metrics",
 ]

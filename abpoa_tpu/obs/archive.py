@@ -136,6 +136,20 @@ def _rotate_if_needed(path: str) -> None:
             pass
 
 
+def find_request(rid: str, window: int = 0,
+                 path: Optional[str] = None) -> Optional[dict]:
+    """Newest archive record carrying request id `rid` (serve requests
+    and pool jobs record one per terminal status, PR 15; the record's
+    `trace_file`/`dump_file` fields point at the request's per-request
+    Chrome trace and harvested flight dump). `abpoa-tpu why` resolves
+    ids through here; `label` matches too so `req-N` labels from older
+    logs still resolve."""
+    for rec in reversed(read_window(window, path=path)):
+        if rec.get("request_id") == rid or rec.get("label") == rid:
+            return rec
+    return None
+
+
 def read_window(n: int, path: Optional[str] = None) -> List[dict]:
     """The newest `n` archive records, oldest-first (rotated generation
     included so a window survives a rotation boundary). Unparseable lines

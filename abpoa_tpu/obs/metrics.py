@@ -463,6 +463,12 @@ _EXACT_FAMILIES = {
     "pool.worker_cache_loads": ("abpoa_pool_worker_cache_loads_total",
                                 "Pool worker compile-cache loads served "
                                 "by the persistent XLA cache"),
+    # PR 15: request tracing + worker flight recorder
+    "pool.flight_dumps": ("abpoa_pool_flight_dumps_total",
+                          "Flight-recorder dumps harvested from killed/"
+                          "crashed pool workers"),
+    "serve.traces": ("abpoa_serve_traces_total",
+                     "Per-request Chrome traces written to --trace-dir"),
 }
 
 _BREAKER_PREFIXES = {
@@ -684,7 +690,8 @@ def materialize_pool_families() -> None:
     publish_pool_workers(0)
     for key in ("pool.restarts", "pool.kills", "pool.requeues",
                 "pool.poison_jobs", "pool.worker_crashes",
-                "pool.worker_xla_compiles", "pool.worker_cache_loads"):
+                "pool.worker_xla_compiles", "pool.worker_cache_loads",
+                "pool.flight_dumps"):
         _REGISTRY.counter(*_EXACT_FAMILIES[key]).inc(0)
 
 

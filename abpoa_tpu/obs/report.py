@@ -231,12 +231,14 @@ class RunReport:
 
     def record_fault(self, kind: str, backend: Optional[str] = None,
                      set_index: Optional[int] = None, detail: str = "",
-                     action: str = "") -> None:
+                     action: str = "", extra: Optional[dict] = None) -> None:
         """One absorbed failure (abpoa_tpu/resilience): what failed, where
         it was headed, and what the degradation ladder did about it. The
         contract of that layer is that NOTHING is swallowed silently —
         every fallback/demotion/quarantine lands here (and in the
-        `faults.<kind>` counter) even when the run then succeeds."""
+        `faults.<kind>` counter) even when the run then succeeds. `extra`
+        carries flat cross-reference fields (request_id, attempt, the
+        harvested flight-dump path) that tie the fault to its request."""
         if not self.enabled:
             return
         self.count(f"faults.{kind}")
@@ -250,6 +252,10 @@ class RunReport:
             rec["detail"] = detail
         if action:
             rec["action"] = action
+        if extra:
+            for k, v in extra.items():
+                if v is not None and k not in rec:
+                    rec[k] = v
         with _metrics._MUT:
             if len(self.faults) >= FAULTS_CAP:
                 self.faults_dropped += 1
@@ -486,8 +492,8 @@ def record_read(wall_s: float, qlen: int, band_cols: int, backend: str,
 
 def record_fault(kind: str, backend: Optional[str] = None,
                  set_index: Optional[int] = None, detail: str = "",
-                 action: str = "") -> None:
-    _REPORT.record_fault(kind, backend, set_index, detail, action)
+                 action: str = "", extra: Optional[dict] = None) -> None:
+    _REPORT.record_fault(kind, backend, set_index, detail, action, extra)
 
 
 def finalize_report() -> dict:

@@ -82,6 +82,7 @@ def evaluate(objectives: dict, records: List[dict]) -> dict:
         budget = float(obj.get("error_budget", 0.0))
         evaluated = bad = 0
         worst: Optional[float] = None
+        offenders: List[tuple] = []   # (value, request id/label) of breaches
         for rec in records:
             v = _metric(rec, metric)
             if v is None:
@@ -91,6 +92,11 @@ def evaluate(objectives: dict, records: List[dict]) -> dict:
                 worst = v
             if v > ceiling:
                 bad += 1
+                offenders.append(
+                    (v, rec.get("request_id") or rec.get("label") or "?"))
+        # the requests that BURNED the budget, worst first — each id is
+        # greppable into its trace/dump via `abpoa-tpu why <id>`
+        offenders.sort(key=lambda t: -t[0])
         bad_frac = bad / evaluated if evaluated else 0.0
         # zero budget means "no run may breach the ceiling": one bad run
         # reads as infinite burn
@@ -107,6 +113,8 @@ def evaluate(objectives: dict, records: List[dict]) -> dict:
             "budget_remaining": round(max(0.0, 1.0 - burn), 4)
             if burn != float("inf") else 0.0,
             "worst": worst,
+            "offenders": [{"id": oid, "value": round(v, 4)}
+                          for v, oid in offenders[:5]],
             "violated": violated,
         })
     return {"window": len(records), "objectives": out,
@@ -128,6 +136,12 @@ def format_table(result: dict, archive_path: str = "") -> str:
             f"{o['bad']:>4}/{o['evaluated']:<4} "
             f"{100 * o['error_budget']:>6.1f}% {burn:>6} {left:>6}  "
             f"{verdict}")
+        if o.get("offenders"):
+            # the ids that burned the budget: `abpoa-tpu why <id>` renders
+            # each one's trace + flight dump
+            ids = "  ".join(f"{of['id']}({of['value']:g})"
+                            for of in o["offenders"][:3])
+            lines.append(f"      burned by: {ids}")
     lines.append("result: " + ("VIOLATED (error budget exhausted)"
                                if result["violated"] else
                                "ok (all objectives within budget)"))

@@ -146,6 +146,18 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
                 parts.append(f"{lbl} {v:.0f}")
         lines.append("pool     " + "  ".join(parts))
 
+    # tracing panel (PR 15): per-request traces written + flight dumps
+    # harvested — the postmortem feed `abpoa-tpu why` consumes
+    traces = _total(samples, "abpoa_serve_traces_total")
+    dumps = _total(samples, "abpoa_pool_flight_dumps_total")
+    if traces or dumps:
+        parts = []
+        if traces:
+            parts.append(f"request traces {traces:.0f}")
+        if dumps:
+            parts.append(f"flight dumps {dumps:.0f}")
+        lines.append("tracing  " + "  ".join(parts))
+
     # abandoned watchdog threads leak IN-PROCESS dispatches only (inside
     # pool workers the supervisor's SIGKILL replaces abandonment), so the
     # readout must not hide behind the pool panel

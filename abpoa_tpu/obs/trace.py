@@ -18,9 +18,22 @@ instead of growing without bound. `RunReport.phase()` forwards its own
 numbers by construction — the trace reconciles with the report exactly,
 not just "within noise".
 
-Single-writer assumption: events append without a lock (CPython list ops
-are atomic; the drivers are single-threaded). Multi-threaded writers
-would only ever interleave events, never corrupt the buffer.
+Concurrency: since PR 15 serve is a multi-threaded writer (dispatch
+workers, watchdog threads, one pool supervisor thread per slot), so the
+ring store, tid assignment and the request index run under one plain
+Lock — a single uncontended acquire per event, the same cost class the
+metrics registry accepted in PR 12 when serve became its first
+concurrent publisher.
+
+Request context (PR 15): every event optionally carries a request tag
+``(rid, attempt)`` taken from a thread-local set by `request_ctx()` — the
+id minted at serve ingress (or per `-l` set under `--workers`) rides every
+span down to `dp:<backend>`/`compile:<fn>`, across the pool-worker pipe
+(worker span deltas are re-added parent-side with `add_foreign`, rebased
+onto the parent-observed dispatch time), and back out as ONE per-request
+Chrome trace via `export_chrome_trace(..., events=events_for(rid))`.
+Sampling (`ABPOA_TPU_TRACE_SAMPLE`, default 1.0) is deterministic on the
+id, so the parent and every worker agree on whether a request is traced.
 """
 from __future__ import annotations
 
@@ -30,24 +43,104 @@ import os
 import sys
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Tuple
 
 DEFAULT_CAPACITY = 65536
 
-# event tuples: (kind, name, cat, t_start_s, dur_s, tid, args)
-# kind: "X" complete span | "i" instant
+# event tuples: (kind, name, cat, t_start_s, dur_s, tid, args, req)
+# kind: "X" complete span | "i" instant; req: None | (rid, attempt)
 _KIND_SPAN = "X"
 _KIND_INSTANT = "i"
+
+# thread-local request context: (rid, attempt) tagged onto every event
+_CTX = threading.local()
+
+# installed flight recorder (obs/flight.py, pool workers): span() notifies
+# it of entry/exit so a SIGKILLed worker's dump names the OPEN span — the
+# one completed spans can never show, because the kill interrupts it
+_FLIGHT = None
+
+
+def new_request_id() -> str:
+    """Mint a request id (12 hex chars) at ingress. Random, not
+    sequential: ids from concurrent servers / restarted processes must
+    not collide in a shared archive."""
+    return os.urandom(6).hex()
+
+
+def current_request() -> Optional[Tuple[str, int]]:
+    return getattr(_CTX, "req", None)
+
+
+@contextlib.contextmanager
+def request_ctx(rid: Optional[str], attempt: int = 0) -> Iterator[None]:
+    """Tag every event recorded by this thread with (rid, attempt) —
+    the propagation primitive: serve workers wrap request execution,
+    pool workers wrap job execution (attempt > 0 there, so a requeued
+    request's two attempts stay distinct in the merged tree)."""
+    if not rid:
+        yield
+        return
+    prev = getattr(_CTX, "req", None)
+    _CTX.req = (rid, int(attempt))
+    try:
+        yield
+    finally:
+        _CTX.req = prev
+
+
+def sample_rate() -> float:
+    try:
+        return float(os.environ.get("ABPOA_TPU_TRACE_SAMPLE", "1") or 1.0)
+    except ValueError:
+        return 1.0
+
+
+def sampled(rid: str) -> bool:
+    """Deterministic per-request sampling decision: a hash of the id
+    against ABPOA_TPU_TRACE_SAMPLE, so every process that sees the id
+    (server, pool supervisor, worker) reaches the same verdict without
+    coordination."""
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0 or not rid:
+        return False
+    try:
+        return (int(rid, 16) % 10_000) < rate * 10_000
+    except ValueError:
+        return True
+
+
+def set_flight(rec) -> None:
+    """Install (or clear, with None) the flight recorder span() notifies."""
+    global _FLIGHT
+    _FLIGHT = rec
+
+
+# per-request index bound: one pathological request cannot grow its
+# slice without limit (the ring's own cap still governs the global view)
+REQUEST_INDEX_CAP = 4096
 
 
 class Tracer:
     """Bounded ring buffer of trace events on a monotonic clock."""
 
-    __slots__ = ("enabled", "capacity", "t0", "_buf", "_n", "_tids")
+    __slots__ = ("enabled", "capacity", "t0", "_buf", "_n", "_tids",
+                 "index_requests", "_req_idx", "_req_drop", "_lock")
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
         self.enabled = False
         self.capacity = capacity
+        # request indexing (serve --trace-dir): registered rids get their
+        # events appended to a side list at store time, so a per-request
+        # export is O(its own events) instead of a full-ring scan per
+        # request (which would grow with server lifetime up to capacity)
+        self.index_requests = False
+        # serve threads write concurrently: ring counter/overwrite and
+        # the request index must not race (a lost `_n` increment would
+        # desync the rotation slice in events() permanently)
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
@@ -55,36 +148,82 @@ class Tracer:
         self._buf: list = []
         self._n = 0          # total events ever added (>= len(_buf))
         self._tids: dict = {}  # thread ident -> dense tid
+        self._req_idx: dict = {}  # rid -> [events], registered rids only
+        self._req_drop: dict = {}  # rid -> events cut at REQUEST_INDEX_CAP
 
     # ------------------------------------------------------------- recording
     def _tid(self) -> int:
         ident = threading.get_ident()
         tid = self._tids.get(ident)
         if tid is None:
-            tid = len(self._tids) + 1
-            self._tids[ident] = tid
+            with self._lock:
+                tid = self._tids.get(ident)
+                if tid is None:
+                    tid = len(self._tids) + 1
+                    self._tids[ident] = tid
         return tid
 
+    def _store(self, ev: tuple) -> None:
+        with self._lock:
+            if self._n < self.capacity:
+                self._buf.append(ev)
+            else:
+                self._buf[self._n % self.capacity] = ev  # overwrite oldest
+            self._n += 1
+            if self.index_requests and ev[7] is not None:
+                rid = ev[7][0]
+                lst = self._req_idx.get(rid)
+                if lst is not None:
+                    if len(lst) < REQUEST_INDEX_CAP:
+                        lst.append(ev)
+                    else:
+                        # never silent: the cut is counted and shipped in
+                        # the export metadata (events_since convention)
+                        self._req_drop[rid] = self._req_drop.get(rid, 0) + 1
+
+    # ------------------------------------------------- request indexing
+    def begin_request(self, rid: str) -> None:
+        """Register a rid for indexed collection. Must happen BEFORE the
+        request becomes visible to dispatch workers (serve registers
+        before try_admit), or a fast request could be accounted — and its
+        slice taken — before registration, leaking the entry."""
+        if self.index_requests and rid:
+            with self._lock:
+                self._req_idx[rid] = []
+
+    def take_request(self, rid: str) -> Optional[Tuple[list, int]]:
+        """Remove and return a registered rid's (indexed events, events
+        cut at REQUEST_INDEX_CAP) — also the leak bound: every registered
+        request's index entry is taken exactly once at account/rejection
+        time."""
+        with self._lock:
+            lst = self._req_idx.pop(rid, None)
+            dropped = self._req_drop.pop(rid, 0)
+            return None if lst is None else (lst, dropped)
+
     def add_span(self, name: str, cat: str, t_start: float, dur: float,
-                 args: Optional[dict] = None) -> None:
+                 args: Optional[dict] = None,
+                 req: Optional[Tuple[str, int]] = None) -> None:
         """Record a completed span from caller-held timestamps (the path
-        RunReport.phase uses, so span == timer to the last bit)."""
-        ev = (_KIND_SPAN, name, cat, t_start, dur, self._tid(), args)
-        if self._n < self.capacity:
-            self._buf.append(ev)
-        else:
-            self._buf[self._n % self.capacity] = ev  # overwrite oldest
-        self._n += 1
+        RunReport.phase uses, so span == timer to the last bit). `req`
+        overrides the thread-local request tag (parent-side bookkeeping
+        spans recorded on behalf of another thread's request)."""
+        self._store((_KIND_SPAN, name, cat, t_start, dur, self._tid(),
+                     args, req if req is not None else current_request()))
 
     def add_instant(self, name: str, cat: str,
                     args: Optional[dict] = None) -> None:
-        ev = (_KIND_INSTANT, name, cat, time.perf_counter(), 0.0,
-              self._tid(), args)
-        if self._n < self.capacity:
-            self._buf.append(ev)
-        else:
-            self._buf[self._n % self.capacity] = ev
-        self._n += 1
+        self._store((_KIND_INSTANT, name, cat, time.perf_counter(), 0.0,
+                     self._tid(), args, current_request()))
+
+    def add_foreign(self, kind: str, name: str, cat: str, t_start: float,
+                    dur: float, tid: int, args: Optional[dict],
+                    req: Optional[Tuple[str, int]]) -> None:
+        """Re-add an event measured in ANOTHER process (a pool worker's
+        shipped span delta), already rebased onto this tracer's timeline;
+        `tid` is the foreign worker's pid so the Chrome trace renders the
+        pipe crossing as separate tracks."""
+        self._store((kind, name, cat, t_start, dur, tid, args, req))
 
     # ------------------------------------------------------------- reading
     @property
@@ -92,11 +231,50 @@ class Tracer:
         return max(0, self._n - self.capacity)
 
     def events(self) -> list:
-        """Events oldest-first (unwrapping the ring)."""
-        if self._n <= self.capacity:
-            return list(self._buf)
-        k = self._n % self.capacity
-        return self._buf[k:] + self._buf[:k]
+        """Events oldest-first (unwrapping the ring); a consistent
+        snapshot under the writer lock."""
+        with self._lock:
+            if self._n <= self.capacity:
+                return list(self._buf)
+            k = self._n % self.capacity
+            return self._buf[k:] + self._buf[:k]
+
+    def tail(self, k: int) -> list:
+        """The newest `k` events, oldest-first, WITHOUT unwrapping the
+        whole ring — O(k) under the lock. The flight recorder reads this
+        once per heartbeat; a full events() copy of a filled 65536-event
+        ring per beat would stall concurrent span recording for the
+        duration of the copy."""
+        with self._lock:
+            if self._n <= self.capacity:
+                return self._buf[-k:]
+            i = self._n % self.capacity   # oldest slot / wrap point
+            if k <= i:
+                return self._buf[i - k:i]
+            return self._buf[-(k - i):] + self._buf[:i]
+
+    def events_since(self, n0: int, cap: int = 2048) -> Tuple[list, int]:
+        """(events recorded after total-count `n0`, dropped) — the
+        per-job span delta a pool worker ships back with its result.
+        Bounded at `cap` newest; overwritten/overflowed events count as
+        dropped, never silently vanish."""
+        new = self._n - n0
+        if new <= 0:
+            return [], 0
+        evs = self.events()
+        take = evs[-min(new, len(evs)):]
+        dropped = new - len(take)
+        if len(take) > cap:
+            dropped += len(take) - cap
+            take = take[-cap:]
+        return take, dropped
+
+    def events_for(self, rid: str) -> list:
+        """Every ring event tagged with request id `rid`, oldest-first —
+        the per-request slice export_chrome_trace turns into one
+        Perfetto-viewable file."""
+        return [e for e in self.events() if e[7] is not None
+                and e[7][0] == rid]
 
 
 _TRACER = Tracer()
@@ -127,15 +305,23 @@ def span(name: str, cat: str = "run",
          args: Optional[dict] = None) -> Iterator[None]:
     """Timed hierarchical span; nesting is expressed by time containment
     (how the Chrome trace format builds its flame graph). Disabled: one
-    attribute check and a bare yield."""
+    attribute check and a bare yield. When a flight recorder is installed
+    (pool workers), entry/exit are mirrored to its open-span stack so a
+    hard kill mid-span is attributable from the harvested dump."""
     if not _TRACER.enabled:
         yield
         return
+    fl = _FLIGHT
     t0 = time.perf_counter()
+    if fl is not None:
+        fl.push_open(name, cat, t0, args)
     try:
         yield
     finally:
-        _TRACER.add_span(name, cat, t0, time.perf_counter() - t0, args)
+        dt = time.perf_counter() - t0
+        _TRACER.add_span(name, cat, t0, dt, args)
+        if fl is not None:
+            fl.pop_open(name, cat, t0, dt, args)
 
 
 def instant(name: str, cat: str = "run", args: Optional[dict] = None) -> None:
@@ -145,20 +331,26 @@ def instant(name: str, cat: str = "run", args: Optional[dict] = None) -> None:
 
 
 def add_span(name: str, cat: str, t_start: float, dur: float,
-             args: Optional[dict] = None) -> None:
+             args: Optional[dict] = None,
+             req: Optional[Tuple[str, int]] = None) -> None:
     """Record a span from caller-held timestamps (RunReport.phase)."""
     if _TRACER.enabled:
-        _TRACER.add_span(name, cat, t_start, dur, args)
+        _TRACER.add_span(name, cat, t_start, dur, args, req=req)
 
 
 # --------------------------------------------------------------------------- #
 # Chrome trace-event export                                                   #
 # --------------------------------------------------------------------------- #
 
-def to_chrome_trace(extra_meta: Optional[dict] = None) -> dict:
+def to_chrome_trace(extra_meta: Optional[dict] = None,
+                    events: Optional[list] = None) -> dict:
     """The trace as a Chrome trace-event JSON object: `ph:"X"` complete
     events with microsecond ts/dur on a run-relative timeline; metadata
-    records process naming and the drop count."""
+    records process naming and the drop count. `events` narrows the
+    export to a subset (the per-request slice from events_for); request
+    tags render as `args.rid`/`args.attempt` so Perfetto's args panel
+    (and `abpoa-tpu why`) can follow one request across threads and the
+    worker-pipe boundary."""
     t = _TRACER
     pid = os.getpid()
     out = [
@@ -171,23 +363,30 @@ def to_chrome_trace(extra_meta: Optional[dict] = None) -> dict:
     out.append({"name": "trace_meta", "ph": "M", "pid": pid, "tid": 0,
                 "args": meta})
     t0 = t.t0
-    for kind, name, cat, ts, dur, tid, args in t.events():
+    for kind, name, cat, ts, dur, tid, args, req in (
+            t.events() if events is None else events):
         ev = {"name": name, "cat": cat, "ph": kind,
               "ts": round((ts - t0) * 1e6, 3), "pid": pid, "tid": tid}
         if kind == _KIND_SPAN:
             ev["dur"] = round(dur * 1e6, 3)
         else:
             ev["s"] = "t"  # thread-scoped instant
-        if args:
-            ev["args"] = args
+        if args or req:
+            a = dict(args) if args else {}
+            if req:
+                a["rid"] = req[0]
+                if req[1]:
+                    a["attempt"] = req[1]
+            ev["args"] = a
         out.append(ev)
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def export_chrome_trace(path: str, fp=None,
-                        extra_meta: Optional[dict] = None) -> None:
+                        extra_meta: Optional[dict] = None,
+                        events: Optional[list] = None) -> None:
     """`--trace FILE` sink ('-' = stdout, or `fp` when stdout is taken)."""
-    text = json.dumps(to_chrome_trace(extra_meta))
+    text = json.dumps(to_chrome_trace(extra_meta, events=events))
     if path == "-":
         (fp or sys.stdout).write(text + "\n")
     else:
@@ -195,11 +394,63 @@ def export_chrome_trace(path: str, fp=None,
             out.write(text + "\n")
 
 
+# prune cadence: the directory listing is the expensive part of the
+# bound, so it runs every 32 exports (the bound is then max_files + 32,
+# still firmly bounded) — not on every request's latency path
+_EXPORTS = {"n": 0}
+
+
+def export_request_trace(dirpath: str, rid: str,
+                         extra_meta: Optional[dict] = None,
+                         max_files: Optional[int] = None,
+                         events: Optional[list] = None) -> Optional[str]:
+    """Write one request's span slice as `req-<rid>.trace.json` under
+    `dirpath` (the serve `--trace-dir` sink). Bounded like the ring:
+    past ABPOA_TPU_TRACE_DIR_MAX files (default 512) the oldest trace
+    files are deleted. `events` short-circuits the ring scan (the serve
+    path passes the request's indexed slice — O(its own events) per
+    request instead of O(ring)). Returns the written path, or None when
+    the request recorded no events / the directory is unwritable
+    (tracing must never fail the request that produced it)."""
+    evs = events if events is not None else _TRACER.events_for(rid)
+    if not evs:
+        return None
+    if max_files is None:
+        max_files = int(os.environ.get("ABPOA_TPU_TRACE_DIR_MAX", "512"))
+    path = os.path.join(dirpath, f"req-{rid}.trace.json")
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        meta = {"request_id": rid, "events": len(evs)}
+        if extra_meta:
+            meta.update(extra_meta)
+        export_chrome_trace(path, extra_meta=meta, events=evs)
+        _EXPORTS["n"] += 1
+        if _EXPORTS["n"] % 32 == 0 or max_files < 32:
+            _prune_trace_dir(dirpath, max_files)
+    except OSError:
+        return None
+    return path
+
+
+def _prune_trace_dir(dirpath: str, max_files: int) -> None:
+    try:
+        names = [n for n in os.listdir(dirpath)
+                 if n.startswith("req-") and n.endswith(".trace.json")]
+        if len(names) <= max_files:
+            return
+        full = sorted((os.path.getmtime(os.path.join(dirpath, n)), n)
+                      for n in names)
+        for _mt, n in full[:len(names) - max_files]:
+            os.unlink(os.path.join(dirpath, n))
+    except OSError:
+        pass
+
+
 def span_totals(cat: Optional[str] = None) -> dict:
     """Per-name wall sums over recorded spans (tests reconcile these with
     the RunReport phase timers)."""
     tot: dict = {}
-    for kind, name, c, _ts, dur, _tid, _args in _TRACER.events():
+    for kind, name, c, _ts, dur, _tid, _args, _req in _TRACER.events():
         if kind == _KIND_SPAN and (cat is None or c == cat):
             tot[name] = tot.get(name, 0.0) + dur
     return tot

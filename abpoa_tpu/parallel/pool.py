@@ -73,6 +73,17 @@ The pool needs no new compile-ladder rungs: each worker runs the same
 declared K=1 signatures as the in-process drivers, against the shared
 persistent XLA cache — which is also what makes a RESTARTED worker warm
 (cache loads, no recompile burst).
+
+Request tracing + flight recorder (PR 15): every job's dispatch frame
+carries the request id minted at ingress and the ATTEMPT number; the
+worker runs the job under that trace context (one always-open `job:`
+span), ships its span delta back with the result (rebased parent-side
+onto the observed dispatch time — one request tree across the pipe), and
+keeps an always-on flight recorder persisted on each heartbeat. When the
+supervisor kills a worker or observes a crash it harvests the dump,
+enriches it with the observed cause (the worker cannot record its own
+SIGKILL), and attaches it to the fault + archive records — the feed
+`abpoa-tpu why` renders into a causal verdict.
 """
 from __future__ import annotations
 
@@ -246,16 +257,22 @@ def worker_init(init: dict) -> None:
     worker's lifetime (so the breaker carries state across jobs exactly
     like a long-lived serial process), core dumps off (injected SIGSEGVs
     are a designed failure mode, not a debuggable event), Params
-    unpickled once."""
+    unpickled once. The span tracer is armed for the worker's lifetime
+    (bounded ring — the PR-7 overhead contract) and the flight recorder
+    installed on top of it: the always-on black box the supervisor
+    harvests when it kills us (obs/flight.py)."""
     try:
         import resource
         resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
     except (ImportError, OSError, ValueError):
         pass
     from .. import obs
+    from ..obs import flight
     obs.start_run()
+    obs.trace_enable()
     _W["abpt"] = pickle.loads(init["params"])
     _W["label"] = init.get("label", "pool")
+    flight.install(label=_W["label"])
 
 
 def worker_rss_bytes() -> int:
@@ -273,12 +290,17 @@ def heartbeat_loop(out, wlock: threading.Lock, job_id: int,
                    stop: threading.Event) -> None:
     """Beat (job id + RSS) while the job executes. Beats only during
     execution: an idle worker writing unread frames would eventually fill
-    the pipe and wedge its own result write behind the full buffer."""
+    the pipe and wedge its own result write behind the full buffer. Each
+    beat also persists the flight-recorder dump (atomic rename), so a
+    kill at any instant leaves a record at most one beat stale."""
+    from ..obs import flight
     hb = heartbeat_s()
     while not stop.wait(hb):
+        rss = worker_rss_bytes()
+        flight.beat(rss)
         try:
             with wlock:
-                write_frame(out, ("hb", job_id, worker_rss_bytes()))
+                write_frame(out, ("hb", job_id, rss))
         except (OSError, ValueError):
             return
 
@@ -444,23 +466,63 @@ _TASKS = {"file": run_file, "records": run_records, "group": run_group}
 
 
 def worker_run_job(job_id: int, kind: str, payload, spec: str,
-                   kill_kind: Optional[str]):
+                   kill_kind: Optional[str], meta: Optional[dict] = None):
     """Execute one job frame in the worker. `spec` is the injection lease
     the supervisor brokered for THIS job; `kill_kind` is a supervisor-
-    fired worker-death injector — die first, run never."""
+    fired worker-death injector — die first, run never. `meta` carries
+    the request context: the id minted at ingress (serve request / `-l`
+    set), the ATTEMPT number (so a requeued request's two attempts stay
+    distinct in traces and merged records instead of conflating under one
+    job), and whether the parent wants this job's span delta shipped back
+    with the result."""
+    from ..obs import flight, trace
     from ..resilience import inject
+    meta = meta or {}
+    rid = meta.get("rid") or ""
+    attempt = int(meta.get("attempt") or 1)
+    # the flight recorder learns the job context BEFORE any chance of
+    # death: an injected kill below must still leave a dump naming us
+    flight.begin_job(rid, attempt, kind, label=meta.get("label", ""))
     if kill_kind:
         sig = (signal.SIGKILL if kill_kind == "worker_kill"
                else signal.SIGSEGV)
         os.kill(os.getpid(), sig)
         time.sleep(10)  # signal delivery can lag; never answer the frame
     inject.configure(spec or "")
-    delay = _test_delay_s()
-    if delay:
-        time.sleep(delay)
     snap = _report_snapshot()
-    result = _TASKS[kind](payload)
-    result["extract"] = _report_delta(snap)
+    n0 = trace.tracer()._n
+    t_job0 = time.perf_counter()
+    status = "done"
+    try:
+        # the job span is the worker-side envelope: always OPEN while the
+        # job executes (the flight dump's "killed mid what?" answer) and
+        # the root of the worker half of the request's span tree. The
+        # service-time shim sleeps inside it — it models service time.
+        with trace.request_ctx(rid, attempt), \
+                trace.span(f"job:{kind}", "job",
+                           args={"label": meta.get("label", ""),
+                                 "pid": os.getpid()}):
+            delay = _test_delay_s()
+            if delay:
+                time.sleep(delay)
+            result = _TASKS[kind](payload)
+    except Exception:
+        status = "error"
+        raise
+    finally:
+        flight.end_job(status)
+    ext = _report_delta(snap)
+    ext["attempt"] = attempt
+    if meta.get("trace"):
+        # ship the job's span delta with times rebased to the job start;
+        # the parent re-anchors them on ITS observed dispatch time and
+        # merges them into the per-request tree (one trace across the
+        # pipe boundary)
+        evs, dropped = trace.tracer().events_since(n0)
+        ext["spans"] = [(k, name, cat, ts - t_job0, dur, args, req)
+                        for k, name, cat, ts, dur, _tid, args, req in evs]
+        ext["spans_dropped"] = dropped
+    result["extract"] = ext
     return "ok", job_id, result
 
 
@@ -481,16 +543,25 @@ class PoolJob:
 
     __slots__ = ("id", "kind", "payload", "label", "deadline_s",
                  "deadline_ts", "est_bytes", "attempts", "status",
-                 "result", "error", "done", "t_submit", "leases")
+                 "result", "error", "done", "t_submit", "leases",
+                 "rid", "trace", "dumps")
 
     def __init__(self, kind: str, payload, label: str = "",
                  deadline_s: Optional[float] = None,
-                 est_bytes: Optional[int] = None) -> None:
+                 est_bytes: Optional[int] = None,
+                 rid: str = "", trace: bool = False) -> None:
         self.id = next(self._ids)
         self.kind = kind
         self.payload = payload
         self.label = label or f"job-{self.id}"
         self.deadline_s = deadline_s
+        # request context (PR 15): the id minted at ingress rides the
+        # dispatch frame into the worker; `trace` asks the worker to ship
+        # its span delta back; `dumps` collects harvested flight dumps
+        # across attempts (newest last)
+        self.rid = rid
+        self.trace = trace
+        self.dumps: List[str] = []
         # an EXPLICIT deadline is a wall budget from submission (a serve
         # request's remaining_s): it spans queue wait, every attempt and
         # respawn backoff — a requeue must not reset the clock. Jobs
@@ -565,7 +636,8 @@ class WorkerPool:
         # pool-local mirrors of the process-cumulative obs counters, for
         # /healthz and snapshot()
         self._counts = {"restarts": 0, "kills": 0, "requeues": 0,
-                        "poison_jobs": 0, "crashes": 0, "jobs": 0}
+                        "poison_jobs": 0, "crashes": 0, "jobs": 0,
+                        "flight_dumps": 0}
         self._stall = stall_s()
 
     # ------------------------------------------------------------ lifecycle
@@ -591,9 +663,10 @@ class WorkerPool:
 
     def submit(self, kind: str, payload, label: str = "",
                deadline_s: Optional[float] = None,
-               est_bytes: Optional[int] = None) -> PoolJob:
+               est_bytes: Optional[int] = None,
+               rid: str = "", trace: bool = False) -> PoolJob:
         job = PoolJob(kind, payload, label=label, deadline_s=deadline_s,
-                      est_bytes=est_bytes)
+                      est_bytes=est_bytes, rid=rid, trace=trace)
         with self._cv:
             if self._closing or self._draining:
                 job.finish("cancelled", error="pool is draining")
@@ -751,6 +824,11 @@ class WorkerPool:
         env["ABPOA_TPU_INJECT"] = ""
         # the parent owns the archive records (exactly one per job)
         env["ABPOA_TPU_ARCHIVE"] = "0"
+        # flight-recorder dumps land where the supervisor will harvest
+        # them (obs/flight.py); pin the resolved default so parent and
+        # worker can never disagree on the directory
+        from ..obs import flight
+        env.setdefault("ABPOA_TPU_FLIGHT_DIR", flight.flight_dir())
         # the parent already made the device decision this pool runs under
         env.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
         env["ABPOA_TPU_RESILIENCE"] = "1" if rz.enabled() else "0"
@@ -972,12 +1050,29 @@ class WorkerPool:
                                            agg.get("fallbacks") or {},
                                            tmp)
 
-    def _merge_extract(self, si: int, ext: dict) -> None:
+    def _merge_extract(self, si: int, ext: dict,
+                       job: Optional[PoolJob] = None,
+                       t_dispatch: Optional[float] = None) -> None:
         """Fold one worker job's report delta into the parent report +
         fleet registry — the parent report is the one `--report`, the
         archive and the chaos assertions read, even when the breaker
-        tripped inside a worker process."""
+        tripped inside a worker process. Shipped span deltas re-anchor on
+        the parent-observed dispatch time and keep their (rid, attempt)
+        tags, so a requeued job's two attempts render as distinct
+        sub-trees of one request trace instead of conflating."""
         from ..obs import count, metrics, record_fault, record_read, report
+        from ..obs import trace as _trace
+        attempt = int(ext.get("attempt") or 0)
+        if (job is not None and t_dispatch is not None
+                and ext.get("spans") and _trace.enabled()):
+            tr = _trace.tracer()
+            wpid = self._slots[si].pid
+            for kind, name, cat, rel, dur, args, req in ext["spans"]:
+                tr.add_foreign(kind, name, cat, t_dispatch + rel, dur,
+                               wpid, args, req)
+            if ext.get("spans_dropped"):
+                count("trace.worker_spans_dropped",
+                      int(ext["spans_dropped"]))
         for name, v in (ext.get("counters") or {}).items():
             # faults.<kind> counters re-materialize via record_fault below
             if name.startswith("faults."):
@@ -1000,11 +1095,21 @@ class WorkerPool:
                 # (wall_s, qlen, band_cols, backend, fallback, amortized)
                 record_read(*r)
         for rec in ext.get("faults") or []:
+            extra = {k: rec.get(k)
+                     for k in ("request_id", "attempt", "dump")
+                     if rec.get(k) is not None}
+            # tag worker faults with the job's request context so a
+            # requeued request's per-attempt fault records stay distinct
+            if job is not None and job.rid:
+                extra.setdefault("request_id", job.rid)
+            if attempt:
+                extra.setdefault("attempt", attempt)
             record_fault(rec.get("kind", "worker_fault"),
                          backend=rec.get("backend"),
                          set_index=rec.get("set"),
                          detail=rec.get("detail", ""),
-                         action=rec.get("action", ""))
+                         action=rec.get("action", ""),
+                         extra=extra or None)
         if ext.get("xla_compiles"):
             count("pool.worker_xla_compiles", int(ext["xla_compiles"]))
         if ext.get("cache_loads"):
@@ -1034,6 +1139,27 @@ class WorkerPool:
             report().mark_reclosed(b)
             if metrics.enabled():
                 metrics.set_breaker_state(b, False)
+
+    def _record_parent_spans(self, job: PoolJob, t_dispatch: float,
+                             wpid: int, status: str = "ok") -> None:
+        """Parent-side envelope spans for one dispatch attempt: the queue
+        wait since submit and the attempt's pipe-to-pipe wall, tagged with
+        the job's request id — the parent half of the cross-process tree
+        (the worker half ships back as a span delta / flight dump)."""
+        from ..obs import trace as _trace
+        if not _trace.enabled():
+            return
+        req = (job.rid, job.attempts) if job.rid else None
+        now = time.perf_counter()
+        if job.attempts == 1:
+            _trace.add_span("pool_wait", "pool", job.t_submit,
+                            max(0.0, t_dispatch - job.t_submit),
+                            args={"label": job.label}, req=req)
+        _trace.add_span(f"pool_job:{job.kind}", "pool", t_dispatch,
+                        now - t_dispatch,
+                        args={"label": job.label, "worker": wpid,
+                              "attempt": job.attempts, "status": status},
+                        req=req)
 
     def _drop_slot_degraded(self, si: int) -> None:
         """A dead worker's breaker state dies with it."""
@@ -1088,10 +1214,16 @@ class WorkerPool:
         job.attempts += 1
         kill_kind = self._lease_kill(job)
         spec = self._build_spec(job)
+        # request context crosses the pipe with the dispatch frame; the
+        # parent-observed dispatch time anchors the worker's shipped span
+        # delta on this timeline
+        meta = {"rid": job.rid, "attempt": job.attempts,
+                "trace": job.trace, "label": job.label}
+        t_dispatch = time.perf_counter()
         try:
             write_frame(slot.stdin,
                         ("job", job.id, job.kind, job.payload, spec,
-                         kill_kind))
+                         kill_kind, meta))
         except (OSError, ValueError):
             # the worker died while IDLE: not this job's doing — no
             # attempt charged, leases refunded, straight back to the front
@@ -1132,6 +1264,8 @@ class WorkerPool:
                 # The lease dies with the worker (fired counts unknowable)
                 self._refund_leases(job, fired=None)
                 self._unbind_kill(job)
+                self._record_parent_spans(job, t_dispatch, slot.pid,
+                                          status="killed_deadline")
                 job.finish("timeout",
                            error=f"{job.label}: killed at the "
                                  f"{deadline:.1f}s job deadline")
@@ -1142,6 +1276,8 @@ class WorkerPool:
                 # burn the lease: what fired in the stalled worker is
                 # unknowable, and a refund could re-kill healthy jobs
                 self._refund_leases(job, fired=None)
+                self._record_parent_spans(job, t_dispatch, slot.pid,
+                                          status="killed_stall")
                 self._after_death(job, "stalled heartbeat")
                 return
             tick = 0.25 if deadline_ts is None else min(
@@ -1164,6 +1300,8 @@ class WorkerPool:
                     count(f"inject.{kill_kind}")
                 self._note_death(si, job)
                 self._refund_leases(job, fired=None)
+                self._record_parent_spans(job, t_dispatch, slot.pid,
+                                          status="worker_died")
                 self._after_death(job, "worker died mid-job")
                 return
             last_beat = time.monotonic()
@@ -1177,6 +1315,8 @@ class WorkerPool:
                         f"{limit} B budget")
                     # same burn as every worker death: fired unknowable
                     self._refund_leases(job, fired=None)
+                    self._record_parent_spans(job, t_dispatch, slot.pid,
+                                              status="killed_rss")
                     self._after_death(job, "RSS budget exceeded")
                     return
                 continue
@@ -1184,7 +1324,9 @@ class WorkerPool:
                 result = frame[2] or {}
                 extract = result.pop("extract", None)
                 if extract:
-                    self._merge_extract(si, extract)
+                    self._merge_extract(si, extract, job=job,
+                                        t_dispatch=t_dispatch)
+                self._record_parent_spans(job, t_dispatch, slot.pid)
                 self._refund_leases(
                     job, fired=(extract or {}).get("counters") or {})
                 self._unbind_kill(job)
@@ -1198,21 +1340,56 @@ class WorkerPool:
                 self._refund_leases(job, fired=None)
                 self._unbind_kill(job)
                 slot.consec_deaths = 0
+                # a worker-side 500 is exactly what `why` exists for:
+                # its trace must still carry the dispatch envelope
+                self._record_parent_spans(job, t_dispatch, slot.pid,
+                                          status="error")
                 record_fault("worker_error", detail=str(frame[2])[:300],
-                             action="propagated")
+                             action="propagated",
+                             extra={"request_id": job.rid or None,
+                                    "attempt": job.attempts})
                 job.finish("error", error=str(frame[2]))
                 return
             # unknown/stale frame: drop it, keep watching
+
+    def _harvest_dump(self, si: int, job: Optional[PoolJob], reason: str,
+                      detail: str) -> Optional[str]:
+        """Collect the dead worker's flight-recorder dump (obs/flight.py):
+        the supervisor enriches it with the observed cause of death —
+        the worker cannot record its own SIGKILL — and attaches the path
+        to the job so the archive record (and `abpoa-tpu why`) can find
+        it. Never fails the containment path."""
+        from ..obs import flight
+        slot = self._slots[si]
+        if not slot.pid:
+            return None
+        try:
+            dest = flight.harvest(slot.pid, reason,
+                                  rid=(job.rid if job else ""),
+                                  attempt=(job.attempts if job else 0),
+                                  detail=detail)
+        except Exception:  # noqa: BLE001 — harvest must never kill the pool
+            return None
+        if dest:
+            self._bump("flight_dumps", "pool.flight_dumps")
+            if job is not None:
+                job.dumps.append(dest)
+        return dest
 
     def _hard_kill(self, si: int, job: PoolJob, why: str,
                    detail: str) -> None:
         from ..obs import record_fault
         slot = self._slots[si]
         self._bump("kills", "pool.kills")
-        record_fault("worker_killed", set_index=None,
-                     detail=f"{job.label}: {detail}", action=f"kill_{why}")
         slot.consec_deaths += 1
         self._kill_proc(slot)
+        # harvest AFTER the kill: the dump on disk is final (no concurrent
+        # writer), at most one heartbeat stale
+        dump = self._harvest_dump(si, job, f"killed_{why}", detail)
+        record_fault("worker_killed", set_index=None,
+                     detail=f"{job.label}: {detail}", action=f"kill_{why}",
+                     extra={"request_id": job.rid or None,
+                            "attempt": job.attempts, "dump": dump})
         self._drop_slot_degraded(si)
 
     def _note_death(self, si: int, job: Optional[PoolJob]) -> None:
@@ -1232,10 +1409,15 @@ class WorkerPool:
             except ValueError:
                 desc = f"signal {-rc}"
         self._bump("crashes", "pool.worker_crashes")
+        dump = self._harvest_dump(si, job, "crashed",
+                                  f"worker pid {slot.pid} died ({desc})")
         record_fault("worker_crash",
                      detail=(f"{job.label}: " if job else "")
                      + f"worker pid {slot.pid} died ({desc})",
-                     action="respawn")
+                     action="respawn",
+                     extra={"request_id": (job.rid or None) if job else None,
+                            "attempt": job.attempts if job else None,
+                            "dump": dump})
         slot.consec_deaths += 1
         self._kill_proc(slot)
         self._drop_slot_degraded(si)
@@ -1269,12 +1451,15 @@ def _archive_job(job: PoolJob, abpt, status: str) -> None:
     """One archive record per job TERMINAL status (idempotent across
     requeues by construction: only the terminal write exists) — the
     window `abpoa-tpu slo` evaluates, same field shapes as the serve
-    per-request records."""
+    per-request records. The record cross-references the job's request
+    id and harvested flight dump, so `slo` offenders and `abpoa-tpu why`
+    can walk from a burned budget to the artifact that explains it."""
     from .. import obs
-    obs.archive.append_record({
+    rec = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "kind": "pool_job",
         "label": job.label,
+        "request_id": job.rid or None,
         "device": abpt.device,
         "status": status,
         "attempts": job.attempts,
@@ -1282,7 +1467,10 @@ def _archive_job(job: PoolJob, abpt, status: str) -> None:
         "reads": 0,
         "faults": 1 if status != "ok" else 0,
         "quarantined": 1 if status != "ok" else 0,
-    })
+    }
+    if job.dumps:
+        rec["dump_file"] = job.dumps[-1]
+    obs.archive.append_record(rec)
 
 
 def run_pool_batch(files: Sequence[str], abpt, out_fp: IO[str],
@@ -1300,8 +1488,16 @@ def run_pool_batch(files: Sequence[str], abpt, out_fp: IO[str],
     count("pool.runs")
     observe("pool.workers", pool.n_workers)
     metrics.publish_batch_progress(0, total=len(files))
-    jobs = [pool.submit("file", (i, fn), label=fn)
-            for i, fn in enumerate(files)]
+    # every `-l` set under --workers gets a request id at ingress (the
+    # PR-15 propagation contract): worker span deltas merge back under it
+    # when the run traces, and the archive/dump records carry it always
+    from ..obs import trace as _trace
+    jobs = []
+    for i, fn in enumerate(files):
+        rid = _trace.new_request_id()
+        jobs.append(pool.submit(
+            "file", (i, fn), label=fn, rid=rid,
+            trace=_trace.enabled() and _trace.sampled(rid)))
     # graceful drain on SIGTERM: queued jobs are cancelled, in-flight
     # jobs finish, completed output is emitted, rc stays 0 (main-thread
     # CLI runs only; library callers keep their own signal handling)
@@ -1386,11 +1582,15 @@ def run_hybrid_batch(files: Sequence[str], abpt, out_fp: IO[str],
     # deadline accordingly, or a healthy k_cap-set group would be killed
     # at the single-set budget
     base_deadline = job_deadline_s()
-    jobs = [pool.submit("group", grp,
-                        label=f"group[{grp[0][0]}..{grp[-1][0]}]",
-                        deadline_s=(base_deadline * len(grp)
-                                    if base_deadline > 0 else None))
-            for grp in groups]
+    from ..obs import trace as _trace
+    jobs = []
+    for grp in groups:
+        rid = _trace.new_request_id()
+        jobs.append(pool.submit(
+            "group", grp, label=f"group[{grp[0][0]}..{grp[-1][0]}]",
+            deadline_s=(base_deadline * len(grp)
+                        if base_deadline > 0 else None),
+            rid=rid, trace=_trace.enabled() and _trace.sampled(rid)))
     # graceful SIGTERM drain, same contract as run_pool_batch: queued
     # groups cancel, in-flight groups finish, completed output is
     # emitted, rc stays 0 (main-thread CLI runs only)

@@ -5,7 +5,9 @@ Length-prefixed pickle frames over stdin/stdout:
 
     parent -> worker   {"params", "label"}                      (init, once)
     worker -> parent   ("ready", pid)
-    parent -> worker   ("job", id, kind, payload, spec, kill)   per job
+    parent -> worker   ("job", id, kind, payload, spec, kill, meta)  per job
+                       meta = {"rid", "attempt", "trace", "label"} — the
+                       request context (PR 15): trace ids cross the pipe
     worker -> parent   ("hb", id, rss_bytes)                    while running
     worker -> parent   ("ok", id, result) | ("err", id, message)
     parent -> worker   None                                     (shutdown)
@@ -35,21 +37,25 @@ def main() -> int:
     wlock = threading.Lock()
     with wlock:
         P.write_frame(proto_out, ("ready", os.getpid()))
+    from abpoa_tpu.obs import flight
     while True:
         try:
             msg = P.read_frame(inp)
         except EOFError:
+            flight.shutdown()   # clean exit: nothing died, no dump kept
             return 0
         if msg is None:
+            flight.shutdown()
             return 0
-        _tag, job_id, kind, payload, spec, kill_kind = msg
+        _tag, job_id, kind, payload, spec, kill_kind, meta = msg
         stop = threading.Event()
         hb = threading.Thread(target=P.heartbeat_loop,
                               args=(proto_out, wlock, job_id, stop),
                               daemon=True, name="abpoa-pool-heartbeat")
         hb.start()
         try:
-            frame = P.worker_run_job(job_id, kind, payload, spec, kill_kind)
+            frame = P.worker_run_job(job_id, kind, payload, spec, kill_kind,
+                                     meta)
         except Exception as e:  # noqa: BLE001 — serialized for the parent,
             # which re-raises it as PoolWorkerError (real bugs propagate)
             import traceback

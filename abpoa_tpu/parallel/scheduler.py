@@ -114,9 +114,12 @@ def plan_route(abpt, n_sets: int, serve: bool = False) -> Route:
     """
     from .runner import _lockstep_ok, lockstep_group_size
     route = _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size)
-    from ..obs import count, metrics
+    from ..obs import count, metrics, trace
     count(f"scheduler.{route.kind}")
     metrics.publish_route(route)
+    # route decisions land on the trace timeline too: a request whose
+    # group ran serial-fallback (or K-capped) can show why in its tree
+    trace.instant("route", "sched", args=route._asdict())
     return route
 
 

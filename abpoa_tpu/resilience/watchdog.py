@@ -179,9 +179,14 @@ def call_with_deadline(fn: Callable, deadline_s: float = None,
                          name=f"abpoa-watchdog:{label}")
     t.start()
     if not done.wait(deadline_s):
-        from ..obs import count
+        from ..obs import count, instant
         count("watchdog.timeouts")
         count("watchdog.abandoned_threads")
+        # the expiry lands in the request's trace (the instant inherits
+        # the thread-local request context), so a 504's span tree shows
+        # WHERE the deadline fired, not just that it did
+        instant("watchdog_timeout", "fault",
+                args={"label": label, "deadline_s": deadline_s})
         _note_abandoned(t)
         raise DispatchTimeout(
             f"{label}: no result within {deadline_s:.1f}s watchdog deadline "

@@ -76,12 +76,19 @@ class Job:
 
     __slots__ = ("id", "label", "records", "n_reads", "rung", "est_bytes",
                  "eligible", "deadline_s", "t_arrive", "done", "status",
-                 "body", "error", "_lock", "_done_marked")
+                 "body", "error", "_lock", "_done_marked",
+                 "rid", "t_pickup", "dumps")
 
     def __init__(self, records, rung: int, est_bytes: int, eligible: bool,
-                 deadline_s: float) -> None:
+                 deadline_s: float, rid: str = "") -> None:
         self.id = next(self._ids)
         self.label = f"req-{self.id}"
+        # the request id minted at ingress (PR 15): rides the response
+        # header, every span down to the pool worker, the archive record
+        # and the flight dump — `abpoa-tpu why <rid>` joins them back up
+        self.rid = rid
+        self.t_pickup: Optional[float] = None   # set when a worker pops us
+        self.dumps: list = []                   # harvested flight dumps
         self.records = records
         self.n_reads = len(records)
         self.rung = rung
@@ -189,6 +196,12 @@ class AdmissionController:
                         self._queue.remove(job)
                         group.append(job)
             self._inflight += len(group)
+            now = time.perf_counter()
+            for job in group:
+                # admission wait ends here; the server records the
+                # admission_wait span from (t_arrive, t_pickup) so queue
+                # time is attributable per request
+                job.t_pickup = now
             self._publish_locked()
             return group
 

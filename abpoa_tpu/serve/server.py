@@ -98,6 +98,7 @@ def _request_record(job: Job, status: str, device: str) -> dict:
         "device": device,
         "status": status,
         "total_wall_s": round(wall, 6),
+        "deadline_s": job.deadline_s,
         "reads": job.n_reads if status == "ok" else 0,
         "read_wall_ms": ({"p50": per_read_ms, "p95": per_read_ms,
                           "p99": per_read_ms, "amortized": True}
@@ -115,10 +116,17 @@ class AlignServer:
     def __init__(self, abpt: Params, host: str = "127.0.0.1", port: int = 0,
                  workers: int = 2, queue_depth: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 pool_workers: Optional[int] = None) -> None:
+                 pool_workers: Optional[int] = None,
+                 trace_dir: Optional[str] = None) -> None:
         if not abpt._finalized:
             abpt = abpt.finalize()
         self.abpt = abpt
+        # per-request tracing (PR 15): with --trace-dir, every sampled
+        # request's span slice (ingress -> admission wait -> dispatch ->
+        # pool worker and back) exports as one Perfetto-viewable Chrome
+        # trace, cross-referenced from its archive record
+        self._trace_dir = trace_dir or os.environ.get(
+            "ABPOA_TPU_SERVE_TRACE_DIR") or None
         self.deadline_s = (deadline_s if deadline_s is not None
                            else default_deadline_s())
         self.admission = AdmissionController(abpt, max_depth=queue_depth)
@@ -165,6 +173,14 @@ class AlignServer:
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="abpoa-serve-http").start()
         obs.start_run()
+        if self._trace_dir:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            obs.trace_enable()
+            # per-request indexing: exports read the request's own slice
+            # instead of scanning the whole ring per request
+            obs.tracer().index_requests = True
+            # materialize the family so "zero traces" reads as 0
+            obs.count("serve.traces", 0)
         device_backend = self.abpt.device in ("jax", "tpu", "pallas")
         if warm == "auto":
             warm = "quick" if device_backend else "off"
@@ -254,10 +270,51 @@ class AlignServer:
 
     def account(self, job: Job, status: str) -> None:
         """Single definition of an admitted job's terminal disposition:
-        per-status stats, the serve metric families, one archive record."""
+        per-status stats, the serve metric families, one archive record
+        carrying the request id and (when produced) the per-request trace
+        and harvested flight-dump paths — the cross-references `abpoa-tpu
+        slo` offenders and `abpoa-tpu why` resolve."""
         self.bump(status, job.wall_s())
-        obs.archive.append_record(
-            _request_record(job, status, self.abpt.device))
+        rec = _request_record(job, status, self.abpt.device)
+        rec["request_id"] = job.rid or None
+        if job.dumps:
+            rec["dump_file"] = job.dumps[-1]
+        tf = self._export_trace(job, status)
+        if tf:
+            rec["trace_file"] = tf
+        obs.archive.append_record(rec)
+
+    def _traced(self, rid: str) -> bool:
+        """THE per-request tracing decision — one definition site for the
+        ingress registration, the pool ship-spans flag, and the export
+        (three copies would drift and produce traces with missing
+        halves)."""
+        return bool(self._trace_dir and rid and obs.sampled(rid))
+
+    def _export_trace(self, job: Job, status: str) -> Optional[str]:
+        """Write this request's span slice as one Chrome trace under
+        --trace-dir (sampled, bounded; obs/trace.export_request_trace).
+        The terminal `request` envelope span is recorded here so every
+        exported trace brackets ingress -> terminal disposition."""
+        if not self._traced(job.rid):
+            if job.rid:
+                obs.tracer().take_request(job.rid)  # drop any indexed slice
+            return None
+        obs.trace.add_span("request", "serve", job.t_arrive, job.wall_s(),
+                           args={"status": status, "reads": job.n_reads,
+                                 "deadline_s": job.deadline_s},
+                           req=(job.rid, 0))
+        taken = obs.tracer().take_request(job.rid)
+        evs, idx_dropped = taken if taken is not None else (None, 0)
+        meta = {"status": status, "label": job.label,
+                "device": self.abpt.device}
+        if idx_dropped:
+            meta["indexed_events_dropped"] = idx_dropped
+        path = obs.export_request_trace(
+            self._trace_dir, job.rid, extra_meta=meta, events=evs)
+        if path:
+            obs.count("serve.traces")
+        return path
 
     def stats(self) -> Dict[str, int]:
         with self._stats_lock:
@@ -315,13 +372,26 @@ class AlignServer:
         """Run one coalesced group to terminal status. Never raises for
         per-request fault shapes: poisoned -> 400, deadline -> 504,
         anything else -> 500 + fault record, and the worker lives on."""
+        # the admission wait ends at pickup: record it per request (with
+        # the coalesced group size — a 504 whose budget drained here must
+        # say so, and behind WHAT), before expiry decides anything
+        if obs.trace_enabled():
+            for job in group:
+                end = job.t_pickup or time.perf_counter()
+                obs.trace.add_span(
+                    "admission_wait", "serve", job.t_arrive,
+                    max(0.0, end - job.t_arrive),
+                    args={"coalesced_k": len(group), "rung": job.rung},
+                    req=(job.rid, 0) if job.rid else None)
         # expire jobs that aged out while queued — their client already
         # gave up; executing them would burn capacity on dead work
         live: List[Job] = []
         for job in group:
             if job.remaining_s() <= 0:
                 obs.record_fault("request_timeout", detail=job.label,
-                                 action="expired_in_queue")
+                                 action="expired_in_queue",
+                                 extra={"request_id": job.rid} if job.rid
+                                 else None)
                 if job.finish("timeout",
                               error="deadline expired in admission queue"):
                     self.account(job, "timeout")
@@ -362,27 +432,33 @@ class AlignServer:
         if self._pool is not None:
             self._finish_single_pool(job, remaining)
             return
+        rid_extra = {"request_id": job.rid} if job.rid else None
         try:
-            body = call_with_deadline(
-                lambda: self._run_single(job, abpt),
-                deadline_s=remaining, label=job.label)
+            # in-thread execution runs under the request context so every
+            # span down to dp:<backend>/compile:<fn> carries the id (the
+            # executing thread re-enters the context in _run_single; the
+            # outer ctx here tags the watchdog's own expiry instant)
+            with obs.request_ctx(job.rid):
+                body = call_with_deadline(
+                    lambda: self._run_single(job, abpt),
+                    deadline_s=remaining, label=job.label)
             if job.finish("ok", body=body):
                 self.account(job, "ok")
         except DispatchTimeout:
             obs.record_fault("request_timeout", detail=job.label,
-                            action="worker_abandoned")
+                            action="worker_abandoned", extra=rid_extra)
             if job.finish("timeout", error="request deadline expired"):
                 self.account(job, "timeout")
         except QUARANTINE_EXCEPTIONS as e:
             # quarantine semantics: a poisoned set is a 400 for THIS
             # request, never a crashed worker
             obs.record_fault("poisoned_set", detail=str(e)[:300],
-                            action="rejected_400")
+                            action="rejected_400", extra=rid_extra)
             if job.finish("poisoned", error=f"{type(e).__name__}: {e}"):
                 self.account(job, "poisoned")
         except Exception as e:  # noqa: BLE001 — worker must survive
             obs.record_fault("request_error", detail=str(e)[:300],
-                            action="rejected_500")
+                            action="rejected_500", extra=rid_extra)
             print(f"[abpoa-tpu serve] {job.label} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             if job.finish("error", error=f"{type(e).__name__}: {e}"):
@@ -397,8 +473,12 @@ class AlignServer:
         quarantine exceptions keep their 400 contract."""
         pj = self._pool.submit("records", (list(job.records),),
                                label=job.label, deadline_s=remaining,
-                               est_bytes=job.est_bytes)
+                               est_bytes=job.est_bytes,
+                               rid=job.rid, trace=self._traced(job.rid))
         pj.done.wait()
+        # harvested flight dumps (killed/crashed attempts) follow the
+        # request into its archive record
+        job.dumps.extend(pj.dumps)
         if pj.status == "ok":
             q = pj.result.get("quarantined")
             if q:
@@ -433,12 +513,14 @@ class AlignServer:
 
     def _run_single(self, job: Job, abpt: Params) -> str:
         from ..pipeline import Abpoa, msa
-        delay = _test_delay_s()
-        if delay:
-            time.sleep(delay)
-        buf = io.StringIO()
-        msa(Abpoa(), abpt, job.records, buf)
-        return buf.getvalue()
+        with obs.request_ctx(job.rid), \
+                obs.span("execute", "serve", args={"label": job.label}):
+            delay = _test_delay_s()
+            if delay:
+                time.sleep(delay)
+            buf = io.StringIO()
+            msa(Abpoa(), abpt, job.records, buf)
+            return buf.getvalue()
 
     def _run_lockstep(self, jobs: List[Job], abpt: Params) -> None:
         """Coalesced same-rung group on an accelerator mesh: ingest each
@@ -578,6 +660,12 @@ def _make_handler(server: AlignServer):
             if self.path.rstrip("/") != "/align":
                 self._json(404, {"error": f"unknown path {self.path!r}"})
                 return
+            # the request id is minted at INGRESS — before parsing, before
+            # admission — and every disposition (shed, poisoned, served)
+            # answers with it, so a client-side latency outlier is
+            # directly greppable into traces/dumps/archive records
+            rid = obs.new_request_id()
+            rh = {"X-Abpoa-Request-Id": rid}
             if server.draining.is_set():
                 # the body was never read: close the connection, or a
                 # keep-alive client's unread bytes would parse as its
@@ -585,7 +673,7 @@ def _make_handler(server: AlignServer):
                 self.close_connection = True
                 server.bump("draining", 0.0)
                 self._json(503, {"error": "server is draining"},
-                           {"Retry-After": "30"})
+                           {"Retry-After": "30", **rh})
                 return
             try:
                 n = int(self.headers.get("Content-Length") or 0)
@@ -593,31 +681,43 @@ def _make_handler(server: AlignServer):
                 # body length unknowable -> body unread -> must close
                 self.close_connection = True
                 server.bump("poisoned", 0.0)
-                self._json(400, {"error": "malformed Content-Length"})
+                self._json(400, {"error": "malformed Content-Length"}, rh)
                 return
             if n > max_body_bytes():
                 self.close_connection = True  # body unread, same as above
                 server.bump("oversized", 0.0)
                 self._json(413, {"error": f"body {n} B exceeds the "
-                                          f"{max_body_bytes()} B limit"})
+                                          f"{max_body_bytes()} B limit"},
+                           rh)
                 return
             raw = self.rfile.read(n) if n else b""
             t0 = time.perf_counter()
             try:
-                job = self._parse_job(raw)
+                job = self._parse_job(raw, rid)
             except Exception as e:  # malformed body: 400, never a crash
                 server.bump("poisoned", time.perf_counter() - t0)
                 obs.record_fault("poisoned_set", detail=str(e)[:300],
-                                 action="rejected_400")
-                self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                                 action="rejected_400",
+                                 extra={"request_id": rid})
+                self._json(400, {"error": f"{type(e).__name__}: {e}"}, rh)
                 return
+            # register for indexed span collection BEFORE the job becomes
+            # visible to dispatch workers: a fast request could otherwise
+            # be fully accounted (slice taken) before registration, and
+            # the late-registered entry would leak forever
+            traced = server._traced(rid)
+            if traced:
+                obs.tracer().begin_request(rid)
             admitted, reason, retry_after = server.admission.try_admit(job)
             if not admitted:
+                if traced:
+                    obs.tracer().take_request(rid)   # never dispatched
                 status = "draining" if reason == "draining" else "rejected"
                 server.bump(status, job.wall_s())
                 code = 503 if reason == "draining" else 429
                 self._json(code, {"error": f"admission rejected: {reason}"},
-                           {"Retry-After": str(int(max(1, retry_after)))})
+                           {"Retry-After": str(int(max(1, retry_after))),
+                            **rh})
                 return
             # wait for the worker verdict; the slack covers worker pickup
             # and the watchdog's own bookkeeping — the worker-side
@@ -628,16 +728,17 @@ def _make_handler(server: AlignServer):
             status = job.status
             if status == "ok":
                 self._send(200, job.body.encode(), "text/x-fasta",
-                           {"X-Abpoa-Reads": str(job.n_reads)})
+                           {"X-Abpoa-Reads": str(job.n_reads), **rh})
             elif status == "poisoned":
-                self._json(400, {"error": job.error})
+                self._json(400, {"error": job.error}, rh)
             elif status == "timeout":
                 self._json(504, {"error": job.error or
-                                 "request deadline expired"})
+                                 "request deadline expired"}, rh)
             else:
-                self._json(500, {"error": job.error or "internal error"})
+                self._json(500, {"error": job.error or "internal error"},
+                           rh)
 
-        def _parse_job(self, raw: bytes) -> Job:
+        def _parse_job(self, raw: bytes, rid: str = "") -> Job:
             from ..io.fastx import read_fastx_text
             from ..resilience import validate_records
             from ..resilience.memory import estimate_bytes
@@ -659,7 +760,7 @@ def _make_handler(server: AlignServer):
             return Job(records, rung=qp_rung(qmax),
                        est_bytes=estimate_bytes(caps),
                        eligible=fused_eligible(server.abpt, len(records)),
-                       deadline_s=deadline)
+                       deadline_s=deadline, rid=rid)
 
     return Handler
 
@@ -708,6 +809,13 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(the `abpoa-tpu top` feed) "
                          "[FILE defaults to ~/.cache/abpoa_tpu/"
                          "metrics.prom]")
+    ap.add_argument("--trace-dir", type=str, default=None, metavar="DIR",
+                    help="write one Perfetto-viewable Chrome trace per "
+                         "sampled request (ABPOA_TPU_TRACE_SAMPLE, "
+                         "default 1.0) into DIR — spans cross the "
+                         "admission queue and the pool-worker pipe under "
+                         "one request id; `abpoa-tpu why <id>` renders "
+                         "them [ABPOA_TPU_SERVE_TRACE_DIR]")
     ap.add_argument("--device", type=str, default="auto",
                     help="DP backend: auto | numpy | native | jax | "
                          "pallas [%(default)s]")
@@ -765,7 +873,8 @@ def serve_main(argv) -> int:
                              workers=args.workers,
                              queue_depth=args.queue_depth,
                              deadline_s=args.deadline_s,
-                             pool_workers=args.pool_workers)
+                             pool_workers=args.pool_workers,
+                             trace_dir=args.trace_dir)
     except OSError as e:
         print(f"Error: cannot bind {args.host}:{args.port}: {e}",
               file=sys.stderr)

@@ -23,6 +23,17 @@ os.environ.setdefault("ABPOA_TPU_ARCHIVE", "0")
 # worker, which the 870s tier-1 budget cannot afford as a side effect.
 # Pool tests opt back in with an explicit Params.workers / --workers N.
 os.environ.setdefault("ABPOA_TPU_WORKERS", "1")
+# pool-worker flight-recorder dumps (obs/flight.py) stay out of the user's
+# ~/.cache/abpoa_tpu/flight; tests that assert on dumps pin their own dir.
+# Removed at interpreter exit so repeated suite runs don't accumulate /tmp
+# directories.
+if "ABPOA_TPU_FLIGHT_DIR" not in os.environ:
+    import atexit as _atexit  # noqa: E402
+    import shutil as _shutil  # noqa: E402
+    import tempfile as _tempfile  # noqa: E402
+    _flight_tmp = _tempfile.mkdtemp(prefix="abpoa_flight_test_")
+    os.environ["ABPOA_TPU_FLIGHT_DIR"] = _flight_tmp
+    _atexit.register(_shutil.rmtree, _flight_tmp, True)
 # persistent compilation cache: the device-path tests are dominated by XLA
 # compile time (minutes per pallas-interpret variant); cache across runs and
 # across the subprocess-isolated children, which inherit this env

@@ -1,0 +1,450 @@
+"""PR-15 tests: cross-process request tracing, the worker flight
+recorder, and the `abpoa-tpu why` postmortem analyzer.
+
+- request-context tagging + per-request export (unit)
+- trace reconciliation across the process boundary: a pool job's
+  worker-side span tree, shipped over the pipe and merged, sums to
+  within 5% of the parent-observed job wall (PR-7 contract extended to
+  the pool path)
+- flight-recorder harvest under injected worker_kill / worker_sigsegv:
+  the fault record carries the dump path, the dump names the job
+- `why` golden-output on a checked-in dump + archive-id lookup
+- slo offender ids; loadgen slowest-N summary
+- sampling-off overhead guard at the PR-6/7 bound
+"""
+import io
+import json
+import os
+import time
+
+import pytest
+
+from conftest import DATA_DIR
+
+SIM2K = os.path.join(DATA_DIR, "sim2k.fa")
+GOLDEN_DUMP = os.path.join(DATA_DIR, "flight_dump.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    rz.inject.reset()
+    obs.trace_disable()
+    yield
+    rz.inject.reset()
+    obs.trace_disable()
+    obs.flight.uninstall()
+    obs.start_run()
+
+
+def _pool_params(workers):
+    from abpoa_tpu.params import Params
+    abpt = Params()
+    abpt.device = "numpy"   # jax-import-free workers: ~0.5s spawns
+    abpt.workers = workers
+    return abpt.finalize()
+
+
+def _sim_files(tmp_path, n, ref_len=120):
+    import subprocess
+    import sys
+    files = []
+    for s in range(n):
+        p = str(tmp_path / f"why{s}.fa")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "make_sim.py"),
+             "--ref-len", str(ref_len), "--n-reads", "4", "--err", "0.1",
+             "--seed", str(900 + s), "--out", p], check=True)
+        files.append(p)
+    return files
+
+
+# --------------------------------------------------------------------- #
+# trace-context units                                                    #
+# --------------------------------------------------------------------- #
+
+def test_request_ctx_tags_and_filters():
+    from abpoa_tpu import obs
+    from abpoa_tpu.obs import trace
+    obs.trace_enable()
+    rid_a, rid_b = obs.new_request_id(), obs.new_request_id()
+    assert rid_a != rid_b and len(rid_a) == 12
+    with obs.request_ctx(rid_a, 1):
+        with obs.span("dp:jax", "dp", args={"Qp": 2048}):
+            pass
+    with obs.request_ctx(rid_b):
+        obs.instant("mark", "t")
+    with obs.span("untagged", "t"):
+        pass
+    evs_a = trace.tracer().events_for(rid_a)
+    assert [e[1] for e in evs_a] == ["dp:jax"]
+    assert evs_a[0][7] == (rid_a, 1)
+    assert [e[1] for e in trace.tracer().events_for(rid_b)] == ["mark"]
+    # the Chrome export renders the tag into args (Perfetto args panel)
+    doc = trace.to_chrome_trace(events=evs_a)
+    ev = doc["traceEvents"][-1]
+    assert ev["args"]["rid"] == rid_a and ev["args"]["attempt"] == 1
+    assert ev["args"]["Qp"] == 2048
+
+
+def test_request_trace_export_bounded(tmp_path):
+    from abpoa_tpu import obs
+    from abpoa_tpu.obs import trace
+    obs.trace_enable()
+    d = str(tmp_path / "traces")
+    paths = []
+    for _ in range(6):
+        rid = obs.new_request_id()
+        with obs.request_ctx(rid):
+            with obs.span("execute", "serve"):
+                pass
+        p = trace.export_request_trace(d, rid, max_files=4)
+        assert p and os.path.exists(p)
+        paths.append(p)
+    # bounded like the ring: only the newest 4 files survive
+    kept = [p for p in paths if os.path.exists(p)]
+    assert len(kept) == 4 and kept == paths[-4:]
+    with open(paths[-1]) as fp:
+        doc = json.load(fp)
+    meta = next(e for e in doc["traceEvents"] if e["name"] == "trace_meta")
+    assert meta["args"]["request_id"] == paths[-1].split("req-")[1].split(
+        ".trace")[0]
+
+
+def test_sampling_is_deterministic():
+    from abpoa_tpu.obs import trace
+    rid = "00000000abcd"
+    os.environ["ABPOA_TPU_TRACE_SAMPLE"] = "0"
+    try:
+        assert not trace.sampled(rid)
+        os.environ["ABPOA_TPU_TRACE_SAMPLE"] = "1"
+        assert trace.sampled(rid)
+        os.environ["ABPOA_TPU_TRACE_SAMPLE"] = "0.5"
+        # same verdict every time (parent and worker must agree)
+        assert len({trace.sampled(rid) for _ in range(10)}) == 1
+    finally:
+        del os.environ["ABPOA_TPU_TRACE_SAMPLE"]
+
+
+# --------------------------------------------------------------------- #
+# cross-process reconciliation + flight harvest                          #
+# --------------------------------------------------------------------- #
+
+def test_pool_trace_reconciles_across_pipe(tmp_path, monkeypatch):
+    """The PR-7 trace==timers contract extended over the pipe: a pool
+    job's worker-side `job:` span (shipped back with the result, rebased
+    onto the parent timeline) sums to within 5% of the parent-observed
+    dispatch wall, and both halves carry the same request id."""
+    from abpoa_tpu import obs
+    from abpoa_tpu.obs import trace
+    from abpoa_tpu.parallel import run_batch
+    monkeypatch.setenv("ABPOA_TPU_POOL_DELAY_S", "0.5")  # dominate overhead
+    files = _sim_files(tmp_path, 1)
+    obs.start_run()
+    obs.trace_enable()
+    out = io.StringIO()
+    stats = run_batch(files * 2, _pool_params(2), out)
+    assert stats["quarantined"] == 0
+    evs = trace.tracer().events()
+    pool_jobs = [e for e in evs if e[1] == "pool_job:file"]
+    worker_jobs = [e for e in evs if e[1] == "job:file"]
+    assert len(pool_jobs) == 2 and len(worker_jobs) == 2
+    by_rid = {}
+    for e in pool_jobs + worker_jobs:
+        assert e[7] is not None, e
+        by_rid.setdefault(e[7][0], []).append(e)
+    assert len(by_rid) == 2  # one id per set, both halves under it
+    for rid, pair in by_rid.items():
+        names = sorted(e[1] for e in pair)
+        assert names == ["job:file", "pool_job:file"]
+        parent = next(e for e in pair if e[1] == "pool_job:file")
+        worker = next(e for e in pair if e[1] == "job:file")
+        # worker span tree wall within 5% of the parent-observed wall
+        assert worker[4] == pytest.approx(parent[4], rel=0.05), (rid, pair)
+        # rebasing: the worker span lies inside the parent bracket
+        assert worker[3] >= parent[3] - 0.05
+        # the pipe boundary is visible: foreign tid = worker pid
+        assert worker[5] != parent[5]
+    # every set also recorded its admission analog (pool_wait)
+    assert sum(1 for e in evs if e[1] == "pool_wait") == 2
+
+
+def test_flight_harvest_on_worker_kill(tmp_path, monkeypatch):
+    """worker_kill:1 -> the supervisor harvests the dead worker's flight
+    dump, attaches it to the fault record and the job's archive record;
+    the dump names the job (rid, attempt) and the observed death."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    monkeypatch.setenv("ABPOA_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "reports"))
+    files = _sim_files(tmp_path, 2)
+    obs.start_run()
+    rz.inject.configure("worker_kill:1")
+    try:
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(2), out)
+    finally:
+        rz.inject.reset()
+    assert stats["quarantined"] == 0
+    crash = [r for r in obs.report().faults if r["kind"] == "worker_crash"]
+    assert crash, obs.report().faults
+    dump_path = crash[0].get("dump")
+    assert dump_path and os.path.exists(dump_path), crash
+    with open(dump_path) as fp:
+        dump = json.load(fp)
+    assert dump["schema"] == "abpoa-tpu-flight"
+    job = dump["job"]
+    assert job["kind"] == "file" and job["attempt"] == 1
+    assert job["rid"] and job["status"].startswith("died:")
+    assert dump["harvest"]["reason"] == "crashed"
+    assert dump["harvest"]["request_id"] == job["rid"]
+    assert obs.report().counters.get("pool.flight_dumps") == 1
+    # the archive record for the killed-then-requeued job references it
+    recs = []
+    with open(tmp_path / "reports" / "reports.jsonl") as fp:
+        recs = [json.loads(ln) for ln in fp]
+    hit = [r for r in recs if r.get("dump_file")]
+    assert len(hit) == 1 and hit[0]["dump_file"] == dump_path
+    assert hit[0]["request_id"] == job["rid"]
+    assert all(r.get("request_id") for r in recs
+               if r.get("kind") == "pool_job")
+
+
+def test_flight_harvest_sigsegv_tags_attempts(tmp_path, monkeypatch):
+    """worker_sigsegv:2 -> the poison job leaves TWO dumps (one per
+    attempt), distinctly tagged — the conflation fix: attempt is carried
+    on the dump, the fault records and the merged telemetry."""
+    from abpoa_tpu import obs
+    from abpoa_tpu import resilience as rz
+    from abpoa_tpu.parallel import run_batch
+    monkeypatch.setenv("ABPOA_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    files = _sim_files(tmp_path, 3)
+    obs.start_run()
+    rz.inject.configure("worker_sigsegv:2")
+    try:
+        out = io.StringIO()
+        stats = run_batch(files, _pool_params(3), out)
+    finally:
+        rz.inject.reset()
+    assert stats["quarantined"] == 1
+    crashes = [r for r in obs.report().faults
+               if r["kind"] == "worker_crash" and r.get("dump")]
+    assert len(crashes) == 2, obs.report().faults
+    attempts = sorted(r["attempt"] for r in crashes)
+    assert attempts == [1, 2]
+    rids = {r["request_id"] for r in crashes}
+    assert len(rids) == 1  # same request, two attempts
+    for rec in crashes:
+        with open(rec["dump"]) as fp:
+            dump = json.load(fp)
+        assert dump["harvest"]["attempt"] == rec["attempt"]
+    assert obs.report().counters.get("pool.flight_dumps") == 2
+
+
+# --------------------------------------------------------------------- #
+# `why`                                                                  #
+# --------------------------------------------------------------------- #
+
+def test_why_golden_dump(capsys):
+    """Golden: `abpoa-tpu why` on the checked-in dump renders a verdict
+    naming the kill, the killed span and its dispatch rung."""
+    from abpoa_tpu.cli import main
+    assert main(["why", GOLDEN_DUMP]) == 0
+    out = capsys.readouterr().out
+    assert "why c0ffee123abc" in out
+    assert "verdict:" in out
+    assert "hard-killed at the job deadline" in out
+    assert "mid `dp:jax`" in out
+    assert "Qp=2048/W=256" in out
+    assert "flight recorder (worker pid 41287" in out
+    assert "open span at death: `dp:jax`" in out
+    assert "rss:" in out and "1612 MB" in out
+
+
+def test_why_request_id_archive_lookup(tmp_path, capsys, monkeypatch):
+    """`why <request-id>` resolves the archive record and pulls the
+    cross-referenced dump; unknown ids are rc=2 with a clear error."""
+    from abpoa_tpu.obs import archive
+    from abpoa_tpu.cli import main
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "reports"))
+    archive.append_record({
+        "ts": "2026-08-04T12:00:44Z", "kind": "serve_request",
+        "label": "req-17", "request_id": "c0ffee123abc",
+        "device": "jax", "status": "timeout", "total_wall_s": 30.04,
+        "deadline_s": 30.0, "reads": 0, "faults": 1, "quarantined": 0,
+        "dump_file": GOLDEN_DUMP,
+    })
+    assert main(["why", "c0ffee123abc"]) == 0
+    out = capsys.readouterr().out
+    assert "status=timeout" in out
+    assert "504:" in out
+    assert "hard-killed at the job deadline mid `dp:jax`" in out
+    assert f"dump: {GOLDEN_DUMP}" in out
+    assert main(["why", "ffffffffffff"]) == 2
+
+
+def test_why_trace_attribution(tmp_path, capsys):
+    """A timeout whose budget drained in admission wait gets the
+    queue-side verdict, coalesced group size named."""
+    from abpoa_tpu.cli import main
+    trace = {"traceEvents": [
+        {"name": "admission_wait", "cat": "serve", "ph": "X", "ts": 0.0,
+         "dur": 28.1e6, "pid": 1, "tid": 1,
+         "args": {"rid": "aa00aa00aa00", "coalesced_k": 8, "rung": 2048}},
+        {"name": "execute", "cat": "serve", "ph": "X", "ts": 28.1e6,
+         "dur": 1.9e6, "pid": 1, "tid": 2,
+         "args": {"rid": "aa00aa00aa00"}},
+    ]}
+    tp = str(tmp_path / "t.trace.json")
+    with open(tp, "w") as fp:
+        json.dump(trace, fp)
+    os.environ["ABPOA_TPU_ARCHIVE_DIR"] = str(tmp_path / "empty")
+    try:
+        archive_rec = {
+            "kind": "serve_request", "request_id": "aa00aa00aa00",
+            "status": "timeout", "total_wall_s": 30.0, "deadline_s": 30.0,
+        }
+        from abpoa_tpu.obs import archive
+        os.environ["ABPOA_TPU_ARCHIVE"] = "1"
+        archive.append_record(archive_rec)
+        assert main(["why", tp]) == 0
+    finally:
+        del os.environ["ABPOA_TPU_ARCHIVE_DIR"]
+        os.environ.pop("ABPOA_TPU_ARCHIVE", None)
+    out = capsys.readouterr().out
+    assert "504: 28.1 s of 30 s budget spent in admission wait behind " \
+           "a coalesced K=8 group" in out
+    assert "admission_wait" in out and "execute" in out
+
+
+# --------------------------------------------------------------------- #
+# satellites: slo offenders, loadgen ids, serve header + archive lint    #
+# --------------------------------------------------------------------- #
+
+def test_slo_prints_budget_burner_ids():
+    from abpoa_tpu.obs.slo import evaluate, format_table
+    objectives = {"objectives": [
+        {"name": "req-wall", "metric": "total_wall_s", "max": 1.0,
+         "error_budget": 0.1}]}
+    records = [{"total_wall_s": 0.1, "request_id": "fast00000000"}
+               for _ in range(8)]
+    records += [{"total_wall_s": 30.0, "request_id": "slowaaaaaaaa"},
+                {"total_wall_s": 12.0, "label": "req-99"}]
+    res = evaluate(objectives, records)
+    obj = res["objectives"][0]
+    assert obj["violated"] and obj["bad"] == 2
+    assert [o["id"] for o in obj["offenders"]] == ["slowaaaaaaaa", "req-99"]
+    table = format_table(res)
+    assert "burned by: slowaaaaaaaa(30)" in table
+    assert "req-99(12)" in table
+
+
+def test_loadgen_slowest_ids():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    gen = loadgen.LoadGen("http://x", [b""], rate=1.0, n=3)
+    gen.requests = [(0.010, "200", "aaa"), (0.500, "504", "bbb"),
+                    (0.050, "200", "ccc")]
+    for dt, _c, _r in gen.requests:
+        gen.sketch.observe(dt)
+    s = gen.summary(1.0)
+    assert [r["id"] for r in s["slowest"]] == ["bbb", "ccc", "aaa"]
+    assert s["slowest"][0] == {"ms": 500.0, "status": "504", "id": "bbb"}
+
+
+def test_serve_request_id_header_and_trace(tmp_path, monkeypatch):
+    """In-process server with --trace-dir: every response carries
+    X-Abpoa-Request-Id; the archive record carries request_id +
+    trace_file; the exported trace brackets admission_wait -> execute ->
+    request under one rid."""
+    import urllib.request
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE", "1")
+    monkeypatch.setenv("ABPOA_TPU_ARCHIVE_DIR", str(tmp_path / "reports"))
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.serve import AlignServer
+    abpt = Params()
+    abpt.device = "numpy"
+    srv = AlignServer(abpt, port=0, workers=1,
+                      trace_dir=str(tmp_path / "traces"))
+    srv.start(warm="off")
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        with open(os.path.join(DATA_DIR, "test.fa"), "rb") as fp:
+            body = fp.read()
+        req = urllib.request.Request(base + "/align", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            rid = r.headers.get("X-Abpoa-Request-Id")
+        assert rid and len(rid) == 12
+        # malformed body also answers with an id
+        import urllib.error
+        req = urllib.request.Request(
+            base + "/align", data=b"@x\nACGT\n+\nII\n", method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "poisoned body must 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert e.headers.get("X-Abpoa-Request-Id")
+            e.read()
+    finally:
+        srv.stop()
+    recs = []
+    with open(tmp_path / "reports" / "reports.jsonl") as fp:
+        recs = [json.loads(ln) for ln in fp]
+    served = [r for r in recs if r.get("kind") == "serve_request"]
+    assert len(served) == 1 and served[0]["request_id"] == rid
+    tf = served[0].get("trace_file")
+    assert tf and os.path.exists(tf)
+    with open(tf) as fp:
+        doc = json.load(fp)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"admission_wait", "execute", "request"} <= names
+    assert all(e["args"]["rid"] == rid for e in spans)
+    req_span = next(e for e in spans if e["name"] == "request")
+    assert req_span["args"]["status"] == "ok"
+
+
+def test_sampling_off_overhead_guard():
+    """With tracing disabled and sampling off, the PR-15 hooks (request
+    context, id minting, flight checks in span()) stay within the PR-6/7
+    overhead bound on a warm native run."""
+    from abpoa_tpu.native import load
+    if load() is None:
+        pytest.skip("native host core unavailable (no C++ toolchain)")
+    from abpoa_tpu import obs
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa_from_file
+
+    def run_once(ctx):
+        abpt = Params()
+        abpt.device = "native"
+        abpt.finalize()
+        t0 = time.perf_counter()
+        if ctx:
+            with obs.request_ctx(obs.new_request_id()):
+                msa_from_file(Abpoa(), abpt, SIM2K, io.StringIO())
+        else:
+            msa_from_file(Abpoa(), abpt, SIM2K, io.StringIO())
+        return time.perf_counter() - t0
+
+    os.environ["ABPOA_TPU_TRACE_SAMPLE"] = "0"
+    try:
+        obs.trace_disable()
+        run_once(False)  # warm
+        with_ctx = min(run_once(True) for _ in range(2))
+        without = min(run_once(False) for _ in range(2))
+    finally:
+        del os.environ["ABPOA_TPU_TRACE_SAMPLE"]
+    assert with_ctx <= without * 1.25 + 0.05, (with_ctx, without)

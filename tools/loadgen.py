@@ -17,7 +17,12 @@ percentiles are directly comparable. Output is one JSON summary:
     {"sent": 240, "rate_target": 40.0, "rate_achieved": 39.7,
      "status": {"200": 180, "429": 57, "504": 3},
      "latency_ms": {"p50": 38.2, "p95": 81.0, "p99": 130.5},
+     "slowest": [{"ms": 4411.0, "status": "504", "id": "c0ffee123abc"}, ...],
      "errors": 0, ...}
+
+The `slowest` entries carry the server-assigned `X-Abpoa-Request-Id` per
+response, so a soak's latency outliers are directly greppable into their
+per-request traces / flight dumps: `abpoa-tpu why <id>`.
 
 Usage:
     python tools/loadgen.py --url http://127.0.0.1:8673 \
@@ -61,6 +66,10 @@ class LoadGen:
         self.errors = 0
         self.client_dropped = 0
         self.bodies_ok: List[bytes] = []
+        # (latency_s, status, server-assigned request id) per response —
+        # the ids make soak latency outliers directly greppable into
+        # their traces/dumps (`abpoa-tpu why <id>`)
+        self.requests: List[tuple] = []
         self._lock = threading.Lock()
         self._inflight = 0
 
@@ -72,12 +81,14 @@ class LoadGen:
         req = urllib.request.Request(self.url + "/align", data=payload,
                                      method="POST", headers=headers)
         t0 = time.perf_counter()
-        code, body = 0, b""
+        code, body, rid = 0, b"", None
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 code, body = r.status, r.read()
+                rid = r.headers.get("X-Abpoa-Request-Id")
         except urllib.error.HTTPError as e:
             code = e.code
+            rid = e.headers.get("X-Abpoa-Request-Id")
             e.read()
         except (urllib.error.URLError, OSError, TimeoutError):
             code = 0  # transport error / client timeout
@@ -85,6 +96,7 @@ class LoadGen:
         with self._lock:
             self.sketch.observe(dt)
             self.status[str(code)] = self.status.get(str(code), 0) + 1
+            self.requests.append((dt, str(code), rid))
             if code == 0:
                 self.errors += 1
             elif code == 200:
@@ -135,6 +147,13 @@ class LoadGen:
                            "p99": ms(0.99),
                            "max": (round(1e3 * sk.max, 2)
                                    if sk.count else None)},
+            # slowest responses with their server-assigned request ids:
+            # each outlier is one `abpoa-tpu why <id>` away from its
+            # trace/flight dump
+            "slowest": [{"ms": round(1e3 * dt, 2), "status": code,
+                         "id": rid}
+                        for dt, code, rid in sorted(
+                            self.requests, key=lambda t: -t[0])[:5]],
         }
 
 

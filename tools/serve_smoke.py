@@ -115,20 +115,30 @@ def _drain_stderr(proc, sink: list) -> None:
 def run_pool_kill_phase(base_env: dict, payload_path: str, oracles: set,
                         tmp: str) -> list:
     """ISSUE-13 phase: serve with --pool-workers 2, SIGKILL a worker
-    mid-soak, assert containment + warm restart. Returns failure strings."""
+    mid-soak, assert containment + warm restart. ISSUE-15 extends it into
+    the chaos proof: with --trace-dir + sampling on, the killed request
+    must yield (a) a per-request Chrome trace whose spans cross the pipe
+    boundary under one request id, (b) a harvested flight-recorder dump
+    attached to its archive record, and (c) an `abpoa-tpu why` verdict
+    naming the kill — and every non-ok archived record must carry a
+    request id. Returns failure strings."""
     import threading
     failures: list = []
     metrics_path = os.path.join(tmp, "metrics_pool.prom")
+    trace_dir = os.path.join(tmp, "traces_pool")
     env = dict(base_env)
     # two kill sources at once: the worker_sigsegv injector crashes ONE
     # request's worker twice (a poison job: quarantined, answered 500,
     # supervisor lives), and an external SIGKILL lands mid-soak (the
     # killed job requeues once and still answers 200)
     env["ABPOA_TPU_INJECT"] = "worker_sigsegv:2"
+    env["ABPOA_TPU_TRACE_SAMPLE"] = "1"
+    env["ABPOA_TPU_FLIGHT_DIR"] = os.path.join(tmp, "flight")
     proc = subprocess.Popen(
         [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port", "0",
          "--device", "jax", "--workers", "2", "--pool-workers", "2",
-         "--warm", "quick", "--metrics", metrics_path],
+         "--warm", "quick", "--metrics", metrics_path,
+         "--trace-dir", trace_dir],
         cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
     try:
         port = read_port(proc)
@@ -253,10 +263,151 @@ def run_pool_kill_phase(base_env: dict, payload_path: str, oracles: set,
         if "Traceback" in "".join(stderr_tail):
             failures.append("pool phase: server stderr carries a "
                             "Traceback:\n" + "".join(stderr_tail)[-2000:])
+
+        # ---- ISSUE-15 chaos proof: traces, dumps, why, archive lint ----
+        failures.extend(check_tracing_artifacts(env, trace_dir))
     finally:
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+    return failures
+
+
+def check_tracing_artifacts(env: dict, trace_dir: str) -> list:
+    """The PR-15 acceptance assertions over the pool phase's leftovers:
+    per-request traces crossing the pipe boundary, harvested flight
+    dumps, `why` verdicts naming the kill, and the archive-record
+    request-id lint."""
+    failures: list = []
+    archive_path = os.path.join(env["ABPOA_TPU_ARCHIVE_DIR"],
+                                "reports.jsonl")
+    recs = []
+    try:
+        with open(archive_path) as fp:
+            for ln in fp:
+                try:
+                    recs.append(json.loads(ln))
+                except ValueError:
+                    failures.append(f"unparseable archive line: {ln[:80]}")
+    except OSError as e:
+        return [f"tracing: archive unreadable: {e}"]
+    reqs = [r for r in recs
+            if r.get("kind") in ("serve_request", "pool_job")]
+
+    # lint: every non-2xx (non-ok) archived record carries a request id
+    bad = [r for r in reqs if r.get("status") != "ok"
+           and not r.get("request_id")]
+    if bad:
+        failures.append(f"tracing: {len(bad)} non-ok archive records "
+                        f"without a request_id: {bad[:2]}")
+
+    # (b) the killed request's harvested flight dump, attached to its
+    # archive record — the mid-soak SIGKILL (requeued, then ok) and the
+    # worker_sigsegv poison job (error) both must have one
+    dumped = [r for r in reqs if r.get("dump_file")]
+    if not dumped:
+        failures.append("tracing: no archive record carries a dump_file "
+                        "(flight-recorder harvest never happened)")
+    for rec in dumped[:1] + [r for r in dumped if r.get("status") != "ok"][:1]:
+        dump_path = rec["dump_file"]
+        if not os.path.exists(dump_path):
+            failures.append(f"tracing: dump_file {dump_path} missing")
+            continue
+        # (c) the `why` verdict names the kill
+        why = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "why", dump_path],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+        print(f"[serve-smoke] why {os.path.basename(dump_path)}:\n"
+              + why.stdout, flush=True)
+        if why.returncode != 0:
+            failures.append(f"tracing: `abpoa-tpu why {dump_path}` "
+                            f"rc={why.returncode}: {why.stderr[-500:]}")
+        elif not ("crashed" in why.stdout or "hard-killed" in why.stdout
+                  or "killed" in why.stdout):
+            failures.append("tracing: why verdict does not name the kill:\n"
+                            + why.stdout)
+        elif "verdict:" not in why.stdout:
+            failures.append("tracing: why output carries no verdict line")
+
+    # (a) a per-request Chrome trace whose spans cross the pipe boundary
+    # under one request id: parent-side pool spans AND worker-side job
+    # spans in one file, all tagged with the file's rid
+    traced = [r for r in reqs if r.get("trace_file")
+              and os.path.exists(r.get("trace_file", ""))]
+    if not traced:
+        failures.append("tracing: no archive record carries a readable "
+                        "trace_file")
+    crossed = 0
+    for rec in traced:
+        with open(rec["trace_file"]) as fp:
+            doc = json.load(fp)
+        spans = [e for e in doc.get("traceEvents", [])
+                 if e.get("ph") == "X"]
+        rids = {(e.get("args") or {}).get("rid") for e in spans}
+        if rids != {rec["request_id"]}:
+            failures.append(f"tracing: {rec['trace_file']} carries "
+                            f"foreign/missing rids: {rids}")
+            continue
+        cats = {e.get("cat") for e in spans}
+        if "pool" in cats and "job" in cats:
+            crossed += 1
+    if traced and not crossed:
+        failures.append("tracing: no per-request trace carries BOTH "
+                        "parent-side pool spans and worker-side job "
+                        "spans (the pipe crossing is invisible)")
+    else:
+        print(f"[serve-smoke] tracing: {len(traced)} per-request traces, "
+              f"{crossed} crossing the worker pipe; {len(dumped)} dumps "
+              "harvested", flush=True)
+    return failures
+
+
+def run_overhead_phase(base_env: dict, payload_path: str, tmp: str) -> list:
+    """ISSUE-15 acceptance: sampled tracing (--trace-dir, sample 1.0)
+    costs <= 2% p50 on the warm serve-smoke payload (the 50 ms shim is
+    part of that payload: it models the calibrated service time the
+    other phases measure against). Two identical numpy-device servers —
+    no warm needed, startup is instant — one traced, one not."""
+    failures: list = []
+    p50 = {}
+    from loadgen import LoadGen
+    with open(payload_path, "rb") as fp:
+        body = fp.read()
+    for mode in ("off", "on"):
+        env = dict(base_env)
+        env.pop("ABPOA_TPU_INJECT", None)
+        env["ABPOA_TPU_TRACE_SAMPLE"] = "1"
+        cmd = [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port",
+               "0", "--device", "numpy", "--workers", "2", "--warm", "off"]
+        if mode == "on":
+            cmd += ["--trace-dir", os.path.join(tmp, "traces_overhead")]
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            port = read_port(proc)
+            base = f"http://127.0.0.1:{port}"
+            import threading
+            threading.Thread(target=_drain_stderr, args=(proc, []),
+                             daemon=True).start()
+            wait_ready(base, proc)
+            LoadGen(base, [body], rate=10.0, n=10, timeout_s=60).run()
+            res = LoadGen(base, [body], rate=10.0, n=80,
+                          timeout_s=60).run()
+            p50[mode] = res["latency_ms"]["p50"]
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    print(f"[serve-smoke] tracing overhead: p50 {p50['off']:.2f} ms "
+          f"untraced -> {p50['on']:.2f} ms traced "
+          f"({100 * (p50['on'] / p50['off'] - 1):+.1f}%)", flush=True)
+    # 2% of the ~55 ms shimmed payload is ~1.1 ms; the extra 1 ms floor
+    # absorbs scheduler jitter on shared CI runners
+    if p50["on"] > p50["off"] * 1.02 + 1.0:
+        failures.append(f"tracing overhead past the 2% bound: "
+                        f"p50 {p50['off']:.2f} ms -> {p50['on']:.2f} ms")
     return failures
 
 
@@ -454,6 +605,7 @@ def main(argv=None) -> int:
 
     if not args.no_pool_phase:
         failures.extend(run_pool_kill_phase(env, payload, oracles, tmp))
+        failures.extend(run_overhead_phase(env, payload, tmp))
 
     if failures:
         for f in failures:
@@ -465,7 +617,9 @@ def main(argv=None) -> int:
           "breaker tripped AND reclosed, drain rc=0, slo ok"
           + ("" if args.no_pool_phase else
              "; pool phase: mid-soak worker SIGKILL contained, requeued, "
-             "respawned warm (0 worker XLA compiles)"))
+             "respawned warm (0 worker XLA compiles), per-request traces "
+             "cross the worker pipe, flight dumps harvested, `why` names "
+             "the kill"))
     return 0
 
 
