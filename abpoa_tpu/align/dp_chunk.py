@@ -27,6 +27,7 @@ and K (set axis, pow2); `abpoa-tpu warm` precompiles the anchors.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import List, Tuple
 
 import numpy as np
@@ -136,49 +137,64 @@ def build_lockstep_tables(g, abpt: Params, query: np.ndarray,
     fused loop would. Any valid topological order yields identical results
     (fused_loop module docstring) — the host graph's reference BFS order is
     used directly.
+
+    The build is a numpy batch scatter over the flattened adjacency: one
+    pass collects the per-row edge lists (Python-object graph, so the list
+    gather itself cannot vectorize), then every table lands in a handful
+    of whole-array ops instead of 2n per-row assignments. The split
+    driver rebuilds these tables for every set of every round, so this is
+    the per-round host cost every many-core/fleet deployment pays.
     """
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
     n = g.node_n
     qlen = len(query)
     nodes = g.nodes
-    idx2nid = g.index_to_node_id
-    n2i = g.node_id_to_index
-    remain = g.node_id_to_max_remain
+    idx2nid = np.asarray(g.index_to_node_id[:n], dtype=np.int64)
+    n2i = np.asarray(g.node_id_to_index)
+    remain = np.asarray(g.node_id_to_max_remain)
 
-    pre_lists = []
-    out_lists = []
-    d_max = 1
-    for i in range(n):
-        nd = nodes[int(idx2nid[i])]
-        pl = [int(n2i[p]) for p in nd.in_ids] if 0 < i < n else []
-        ol = [int(n2i[o]) for o in nd.out_ids] if 0 < i < n - 1 else []
-        pre_lists.append(pl)
-        out_lists.append(ol)
-        d_max = max(d_max, len(pl), len(ol))
+    ordered = [nodes[nid] for nid in idx2nid.tolist()]
+    # mask semantics: pre rows exclude the source row 0, out rows exclude
+    # source AND sink (0, n-1) — empty lists instead of slicing later so
+    # the flattened scatter below needs no row filtering
+    pre_lists = [nd.in_ids for nd in ordered]
+    out_lists = [nd.out_ids for nd in ordered]
+    pre_lists[0] = []
+    out_lists[0] = []
+    out_lists[-1] = []
+    pre_lens = np.fromiter(map(len, pre_lists), np.int64, count=n)
+    out_lens = np.fromiter(map(len, out_lists), np.int64, count=n)
+    d_max = max(1, int(pre_lens.max(initial=0)),
+                int(out_lens.max(initial=0)))
     P = max(P_FLOOR, _bucket_pow2(d_max))
-    base_r = np.zeros(n, np.int32)
-    pre_idx = np.zeros((n, P), np.int32)
-    pre_msk = np.zeros((n, P), bool)
-    out_idx = np.zeros((n, P), np.int32)
-    out_msk = np.zeros((n, P), bool)
+
+    def _scatter(lists, lens):
+        """(n, P) idx/msk tables from ragged per-row node-id lists: flat
+        gather + one fancy-indexed scatter (no per-row assignments)."""
+        idx = np.zeros((n, P), np.int32)
+        msk = np.zeros((n, P), bool)
+        total = int(lens.sum())
+        if total:
+            flat = np.fromiter(
+                itertools.chain.from_iterable(lists), np.int64, count=total)
+            rows = np.repeat(np.arange(n), lens)
+            starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+            cols = np.arange(total) - np.repeat(starts, lens)
+            idx[rows, cols] = n2i[flat]
+            msk[rows, cols] = True
+        return idx, msk
+
+    pre_idx, pre_msk = _scatter(pre_lists, pre_lens)
+    out_idx, out_msk = _scatter(out_lists, out_lens)
+    base_r = np.fromiter((nd.base for nd in ordered), np.int32, count=n)
+    remain_rows = remain[idx2nid].astype(np.int32)
     row_active = np.zeros(n, bool)
-    remain_rows = np.zeros(n, np.int32)
-    for i in range(n):
-        nd = nodes[int(idx2nid[i])]
-        base_r[i] = nd.base
-        remain_rows[i] = remain[int(idx2nid[i])]
-        pl = pre_lists[i]
-        pre_idx[i, :len(pl)] = pl
-        pre_msk[i, :len(pl)] = True
-        ol = out_lists[i]
-        out_idx[i, :len(ol)] = ol
-        out_msk[i, :len(ol)] = True
-        row_active[i] = 0 < i < n - 1
+    row_active[1:n - 1] = True
     mpl0 = np.full(n, n, np.int32)
     mpl0[0] = 0
     mpr0 = np.zeros(n, np.int32)
-    src_rows = [int(n2i[o]) for o in nodes[C.SRC_NODE_ID].out_ids]
+    src_rows = n2i[np.asarray(nodes[C.SRC_NODE_ID].out_ids, np.int64)]
     mpl0[src_rows] = 1
     mpr0[src_rows] = 1
 

@@ -277,6 +277,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["serve"]:
         from .serve import serve_main
         return serve_main(raw[1:])
+    if raw[:1] == ["fleet"]:
+        from .serve.fleet import fleet_main
+        return fleet_main(raw[1:])
     if raw[:1] == ["slo"]:
         from .obs.slo import slo_main
         return slo_main(raw[1:])

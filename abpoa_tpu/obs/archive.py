@@ -136,6 +136,47 @@ def _rotate_if_needed(path: str) -> None:
             pass
 
 
+def fleet_dirs(base: Optional[str] = None) -> List[str]:
+    """Per-replica archive dirs under a fleet base: the supervisor gives
+    replica i its own ``<base>/replica-rI`` via ABPOA_TPU_ARCHIVE_DIR, so
+    replica archives never interleave. Falls back to [base] itself when
+    no replica subdirs exist — `slo --fleet` / `why --fleet` over a
+    single-process archive degrade to the non-fleet behavior."""
+    base = base or archive_dir()
+    try:
+        subs = sorted(os.path.join(base, d) for d in os.listdir(base)
+                      if d.startswith("replica-")
+                      and os.path.isdir(os.path.join(base, d)))
+    except OSError:
+        subs = []
+    return subs or [base]
+
+
+def read_fleet_window(n: int, base: Optional[str] = None) -> List[dict]:
+    """The newest `n` records across every replica archive, merged in
+    timestamp order — the `slo --fleet` evaluation window."""
+    out: List[dict] = []
+    for d in fleet_dirs(base):
+        out.extend(read_window(n, path=os.path.join(d, ARCHIVE_FILE)))
+    out.sort(key=lambda r: r.get("ts") or "")
+    return out[-n:] if n else out
+
+
+def find_request_fleet(rid: str, window: int = 0,
+                       base: Optional[str] = None) -> List[dict]:
+    """ALL records carrying request id `rid` across replica archives —
+    a failed-over or hedged request leaves one record per delivery
+    attempt, each in its own replica's archive. Ordered by attempt then
+    timestamp so `why` can narrate the hop."""
+    hits: List[dict] = []
+    for d in fleet_dirs(base):
+        for rec in read_window(window, path=os.path.join(d, ARCHIVE_FILE)):
+            if rec.get("request_id") == rid or rec.get("label") == rid:
+                hits.append(rec)
+    hits.sort(key=lambda r: (r.get("attempt") or 1, r.get("ts") or ""))
+    return hits
+
+
 def find_request(rid: str, window: int = 0,
                  path: Optional[str] = None) -> Optional[dict]:
     """Newest archive record carrying request id `rid` (serve requests

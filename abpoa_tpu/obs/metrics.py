@@ -856,6 +856,122 @@ def sample_value(samples, name: str, **labels) -> Optional[float]:
                                         for k, v in labels.items())))
 
 
+def sketch_from_exposition(samples, base: str) -> LogSketch:
+    """Reconstruct a `LogSketch` from a rendered histogram family.
+
+    Every abpoa histogram is a LogSketch on the SAME fixed bucket grid, so
+    each `le` in the exposition maps back to its exact bucket index
+    (round-trip through the `{ub:.9g}` render is exact at 5% bucket
+    spacing) and counts reconstruct losslessly. Only the exact observed
+    min/max are not in the exposition — they degrade to the edge buckets'
+    bounds, which moves quantile answers by at most one half-bucket and
+    keeps the declared RELATIVE_ERROR contract (tested).
+    """
+    sk = LogSketch()
+    buckets = []
+    for (n, lb), v in samples.items():
+        if n == base + "_bucket":
+            le = dict(lb).get("le")
+            if le and le != "+Inf":
+                buckets.append((float(le), v))
+    if not buckets:
+        return sk
+    buckets.sort()
+    prev = 0.0
+    for le, cum in buckets:
+        c = int(round(cum - prev))
+        prev = cum
+        i = int(round((math.log(le) - LogSketch._LOG_LO)
+                      / LogSketch._LOG_G)) - 1
+        i = max(0, min(LogSketch.N_BUCKETS - 1, i))
+        sk.counts[i] += c
+    sk.count = int(round(prev))
+    s = samples.get((base + "_sum", frozenset()))
+    sk.sum = float(s) if s is not None else 0.0
+    nz = [i for i, c in enumerate(sk.counts) if c]
+    sk.min = LogSketch.LO * LogSketch.GROWTH ** nz[0]
+    sk.max = LogSketch.LO * LogSketch.GROWTH ** (nz[-1] + 1)
+    return sk
+
+
+def merge_expositions(texts: List[str]) -> str:
+    """Merge N Prometheus expositions into one fleet-wide rollup.
+
+    Counters and gauges sum per (family, label set) — for the families
+    this process exports, sums are the fleet-meaningful rollup (total
+    requests, total queue depth, breakers open). Histograms merge at the
+    LogSketch bucket level (`sketch_from_exposition` + bucket-wise add),
+    so merged quantiles carry the same declared tolerance as any single
+    sketch. Quantile *gauges* over a merged histogram are recomputed from
+    the merged sketch rather than summed — a sum of p99s is meaningless.
+
+    The fleet router's `/metrics` rollup and the standalone `slo --fleet`
+    path both go through here, so the two can never disagree.
+    """
+    parsed = []
+    helps: Dict[str, str] = {}
+    types_all: Dict[str, str] = {}
+    order: List[str] = []
+    for text in texts:
+        samples, types = parse_exposition(text)
+        parsed.append(samples)
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[2] not in helps:
+                    helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+        for fam, t in types.items():
+            if fam not in types_all:
+                types_all[fam] = t
+                order.append(fam)
+    hist_bases = {f for f, t in types_all.items() if t == "histogram"}
+    sketches: Dict[str, LogSketch] = {}
+    for base in hist_bases:
+        sk = LogSketch()
+        for samples in parsed:
+            part = sketch_from_exposition(samples, base)
+            if part.count:
+                sk.merge(part)
+        sketches[base] = sk
+    out: List[str] = []
+    for fam in order:
+        t = types_all[fam]
+        if helps.get(fam):
+            out.append(f"# HELP {fam} {helps[fam]}")
+        out.append(f"# TYPE {fam} {t}")
+        if t == "histogram":
+            sk = sketches[fam]
+            buckets = sk.bucket_upper_bounds()
+            total = buckets[-1][1] if buckets else 0
+            for ub, acc in buckets:
+                out.append(f'{fam}_bucket{{le="{ub:.9g}"}} {acc}')
+            out.append(f'{fam}_bucket{{le="+Inf"}} {total}')
+            out.append(f"{fam}_sum {_num(sk.sum)}")
+            out.append(f"{fam}_count {total}")
+            continue
+        base = fam[:-len("_quantile")] if fam.endswith("_quantile") else None
+        if t == "gauge" and base in hist_bases:
+            sk = sketches[base]
+            qlabels = sorted({dict(lb).get("quantile")
+                              for samples in parsed
+                              for (n, lb) in samples if n == fam})
+            for ql in qlabels:
+                if ql is None or not sk.count:
+                    continue
+                out.append(f'{fam}{{quantile="{ql}"}} '
+                           f'{_num(round(sk.quantile(float(ql)), 9))}')
+            continue
+        acc: Dict[frozenset, float] = {}
+        for samples in parsed:
+            for (n, lb), v in samples.items():
+                if n == fam:
+                    acc[lb] = acc.get(lb, 0.0) + v
+        for lb in sorted(acc, key=lambda s: sorted(s)):
+            out.append(f"{fam}{_fmt_labels(tuple(sorted(lb)))} "
+                       f"{_num(acc[lb])}")
+    return "\n".join(out) + "\n"
+
+
 def lint_exposition(text: str) -> List[str]:
     """Structural lint of a Prometheus text exposition: every sample's
     family has a TYPE, counters end in _total, histograms carry a +Inf

@@ -170,6 +170,10 @@ def slo_main(argv) -> int:
     ap.add_argument("--window", type=int, default=None, metavar="N",
                     help="newest N runs to evaluate [objectives file "
                          f"window_runs, else {DEFAULT_WINDOW}]")
+    ap.add_argument("--fleet", action="store_true",
+                    help="evaluate the merged window across every "
+                         "replica archive (replica-* subdirs of the "
+                         "archive dir, as laid out by `abpoa-tpu fleet`)")
     ap.add_argument("--json", default=None, metavar="FILE",
                     help="also write the machine-readable result "
                          "('-' for stdout)")
@@ -187,7 +191,10 @@ def slo_main(argv) -> int:
               file=sys.stderr)
         return 2
     window = args.window or objectives.get("window_runs", DEFAULT_WINDOW)
-    records = archive.read_window(window)
+    if args.fleet:
+        records = archive.read_fleet_window(window)
+    else:
+        records = archive.read_window(window)
     if not records:
         print(f"Error: no archived runs under {archive.archive_dir()} "
               "(run with archiving enabled first; see --report/--metrics "
@@ -200,7 +207,10 @@ def slo_main(argv) -> int:
               file=sys.stderr)
         return 2
     if not args.quiet:
-        sys.stdout.write(format_table(result, archive.archive_path()))
+        src = (f"{len(archive.fleet_dirs())} replica archives under "
+               f"{archive.archive_dir()}" if args.fleet
+               else archive.archive_path())
+        sys.stdout.write(format_table(result, src))
     if args.json:
         text = json.dumps(result, indent=1)
         if args.json == "-":

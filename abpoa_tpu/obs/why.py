@@ -71,6 +71,37 @@ def find_record(rid: str, window: int = 0) -> Optional[dict]:
     return archive.find_request(rid, window)
 
 
+def find_fleet_records(rid: str, window: int = 0) -> List[dict]:
+    """Every record for this id across replica archives — one per
+    delivery attempt when the fleet router failed over or hedged."""
+    return archive.find_request_fleet(rid, window)
+
+
+def pick_terminal(hits: List[dict]) -> Optional[dict]:
+    """The record `why` narrates when a request has several (fleet hop):
+    the served one if any attempt succeeded, else the last attempt."""
+    if not hits:
+        return None
+    for rec in hits:
+        if rec.get("status") == "ok":
+            return rec
+    return hits[-1]
+
+
+def fleet_hop_lines(hits: List[dict]) -> List[str]:
+    """The hop story: one line per delivery attempt across replicas —
+    how a replica death became a retried 200 instead of an outage."""
+    lines = [f"fleet hops ({len(hits)} delivery attempts):"]
+    for rec in hits:
+        lines.append(
+            f"  attempt {rec.get('attempt') or 1}: "
+            f"replica {rec.get('replica') or '?'}  "
+            f"status={rec.get('status')}  "
+            f"wall={_fmt_s(rec.get('total_wall_s'))}"
+            + (f"  at {rec['ts']}" if rec.get("ts") else ""))
+    return lines
+
+
 # --------------------------------------------------------------------------- #
 # analysis                                                                    #
 # --------------------------------------------------------------------------- #
@@ -217,6 +248,10 @@ def render_why(record: Optional[dict], trace_doc: Optional[dict],
                  f"  wall={_fmt_s(record.get('total_wall_s'))}"
                  + (f"  device={record.get('device')}"
                     if record.get("device") else "")
+                 + (f"  replica={record.get('replica')}"
+                    if record.get("replica") else "")
+                 + (f"  attempt={record.get('attempt')}"
+                    if (record.get("attempt") or 1) > 1 else "")
                  + (f"  at {record.get('ts')}" if record.get("ts") else ""))
     lines.append(head)
     lines.append("")
@@ -313,10 +348,17 @@ def why_main(argv) -> int:
                          "~/.cache/abpoa_tpu/reports]")
     ap.add_argument("--window", type=int, default=0, metavar="N",
                     help="newest N archive records to search [all]")
+    ap.add_argument("--fleet", action="store_true",
+                    help="search every replica archive (replica-* subdirs "
+                         "of the archive dir) and narrate the delivery "
+                         "hops of a failed-over/hedged request; ids that "
+                         "miss the plain archive fall back to the fleet "
+                         "search automatically")
     args = ap.parse_args(argv)
     if args.archive_dir:
         os.environ["ABPOA_TPU_ARCHIVE_DIR"] = args.archive_dir
     record = trace_doc = dump = None
+    hops: List[dict] = []
     if os.path.exists(args.what):
         try:
             trace_doc, dump = load_artifact(args.what)
@@ -334,7 +376,17 @@ def why_main(argv) -> int:
         if rid:
             record = find_record(rid, args.window)
     else:
-        record = find_record(args.what, args.window)
+        if args.fleet:
+            hops = find_fleet_records(args.what, args.window)
+            record = pick_terminal(hops)
+        else:
+            record = find_record(args.what, args.window)
+            if record is None:
+                # a fleet request's records live in replica subdirs the
+                # plain lookup never sees — resolve across them before
+                # giving up
+                hops = find_fleet_records(args.what, args.window)
+                record = pick_terminal(hops)
         if record is None:
             print(f"Error: request id {args.what!r} not found in the "
                   f"archive under {archive.archive_dir()} (and it is not "
@@ -355,4 +407,16 @@ def why_main(argv) -> int:
             if slot == "dump" and dump is None:
                 dump = d
     sys.stdout.write(render_why(record, trace_doc, dump, ref=args.what))
+    if len(hops) > 1:
+        # more than one delivery attempt: name the replica hop (the
+        # failover/hedge explanation the fleet chaos proof asserts on)
+        sys.stdout.write("\n" + "\n".join(fleet_hop_lines(hops)) + "\n")
+    elif record is not None and (record.get("attempt") or 1) > 1:
+        # a SIGKILLed replica archives nothing for the lost attempt;
+        # the surviving record's attempt number still tells the story
+        sys.stdout.write(
+            f"\nfleet: delivered on attempt {record['attempt']} by "
+            f"replica {record.get('replica') or '?'} — the earlier "
+            "attempt left no archive record (its replica died "
+            "mid-request; the router failed the request over)\n")
     return 0

@@ -33,6 +33,13 @@ The robustness contract, mechanism by mechanism:
 - **Drain**: SIGTERM/SIGINT stops admission (new requests get 503),
   finishes in-flight work, flushes metrics and the report archive, and
   exits 0.
+- **Replication** (fleet.py + router.py): `--replicas N` (or
+  `abpoa-tpu fleet`) runs N supervised serve processes behind one
+  failover router — crash respawn with backoff, exactly-once retry of a
+  request whose replica died mid-flight (same request id, attempt N+1,
+  `why` narrates the hop), bounded p99 hedging, shed/Retry-After
+  propagation, SIGHUP rolling restarts that never drop below N-1
+  ready, and a merged fleet /metrics exposition.
 
 Each terminal request lands one `obs/archive.py` record, so
 `abpoa-tpu slo` evaluates the served window the same way it evaluates
@@ -43,4 +50,9 @@ from .admission import AdmissionController, Job, request_caps
 from .server import AlignServer, serve_main
 
 __all__ = ["AdmissionController", "Job", "request_caps", "AlignServer",
-           "serve_main"]
+           "serve_main", "fleet_main"]
+
+
+def fleet_main(argv):  # lazy: the fleet pulls in router + supervisor
+    from .fleet import fleet_main as _fm
+    return _fm(argv)

@@ -77,16 +77,22 @@ class Job:
     __slots__ = ("id", "label", "records", "n_reads", "rung", "est_bytes",
                  "eligible", "deadline_s", "t_arrive", "done", "status",
                  "body", "error", "_lock", "_done_marked",
-                 "rid", "t_pickup", "dumps")
+                 "rid", "t_pickup", "dumps", "attempt")
 
     def __init__(self, records, rung: int, est_bytes: int, eligible: bool,
-                 deadline_s: float, rid: str = "") -> None:
+                 deadline_s: float, rid: str = "",
+                 attempt: int = 1) -> None:
         self.id = next(self._ids)
         self.label = f"req-{self.id}"
         # the request id minted at ingress (PR 15): rides the response
         # header, every span down to the pool worker, the archive record
         # and the flight dump — `abpoa-tpu why <rid>` joins them back up
         self.rid = rid
+        # which delivery of this request id we are (PR 16): the fleet
+        # router re-sends a request after a replica death (attempt 2) and
+        # for hedges; the archive record keeps it so `why` can explain
+        # the hop
+        self.attempt = max(1, attempt)
         self.t_pickup: Optional[float] = None   # set when a worker pops us
         self.dumps: list = []                   # harvested flight dumps
         self.records = records

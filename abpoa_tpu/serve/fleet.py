@@ -1,0 +1,406 @@
+"""Replica fleet supervisor: `abpoa-tpu fleet --replicas N`.
+
+Spawns N `abpoa-tpu serve` processes (same flags, same persistent XLA
+compile cache — replica 1 pays each rung's compile once, the rest hit
+the cache), fronted by one serve/router.py FleetRouter that owns the
+public socket. The supervisor is the process-lifecycle half:
+
+- **spawn**: each replica gets ``--port 0`` (the supervisor learns the
+  ephemeral port from the replica's own "listening on" line),
+  ``ABPOA_TPU_REPLICA=rI`` so its archive records, response headers and
+  /healthz name it, and ``ABPOA_TPU_ARCHIVE_DIR=<base>/replica-rI`` so
+  replica archives never interleave (`slo --fleet` / `why` merge them
+  back).
+- **liveness**: a dead process (crash, OOM-kill, SIGKILL chaos) is
+  respawned under the same exponential backoff the worker pool uses
+  (`parallel.pool.restart_backoff_s`); a WEDGED replica — process alive
+  but /healthz unanswered past ABPOA_TPU_FLEET_STALL_S — is SIGKILLed
+  first, then respawned. Fast-crash loops back off instead of spinning.
+- **rolling restart**: SIGHUP to the supervisor drains and restarts one
+  replica at a time — each waits for the fleet to be back at FULL
+  strength before the next drain begins, so ready capacity never drops
+  below N-1. The replica itself gets SIGHUP, which serve treats as the
+  same graceful drain as SIGTERM.
+- **fleet drain**: SIGTERM/SIGINT stops router admissions (503 +
+  Retry-After), SIGTERMs every replica, waits for their graceful
+  drains, and exits 0 — the single-process contract, fleet-wide.
+
+`--metrics` maintains a textfile with the MERGED fleet exposition
+(router scrape roll-up via `metrics.merge_expositions`), so one
+`abpoa-tpu top` watches the whole fleet.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import obs
+from ..obs import archive
+from ..parallel.pool import WorkerPool, restart_backoff_s
+
+# the same spawn budget the worker pool retires a slot under
+MAX_SPAWN_FAILURES = WorkerPool.MAX_SPAWN_FAILURES
+from .router import FleetRouter
+from .server import _build_parser
+
+_LISTEN_RE = re.compile(r"listening on http://([^\s:]+):(\d+)")
+
+# a replica that survives this long has left its crash loop behind
+_STABLE_S = 30.0
+# grace for a SIGHUP/SIGTERM drain before the supervisor hard-kills
+_DRAIN_GRACE_S = 45.0
+
+
+def stall_s() -> float:
+    """Heartbeat ceiling: a live process whose /healthz has not answered
+    for this long is wedged and gets SIGKILL + respawn. 0 disables."""
+    return float(os.environ.get("ABPOA_TPU_FLEET_STALL_S", "60"))
+
+
+def _replica_argv(argv: List[str]) -> List[str]:
+    """The serve argv a replica inherits: everything the operator passed
+    minus the fleet-level flags (--replicas, --host/--port which belong
+    to the ROUTER socket, and --metrics which the fleet rolls up)."""
+    out: List[str] = []
+    skip = False
+    for i, a in enumerate(argv):
+        if skip:
+            skip = False
+            continue
+        if a.startswith(("--replicas=", "--host=", "--port=", "--metrics=")):
+            continue
+        if a in ("--replicas", "--host", "--port"):
+            skip = True
+            continue
+        if a == "--metrics":
+            # nargs="?": consume the value only when one follows
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            skip = nxt is not None and not nxt.startswith("-")
+            continue
+        out.append(a)
+    return out
+
+
+class Replica:
+    """One supervised serve process."""
+
+    __slots__ = ("index", "name", "proc", "port", "base_url",
+                 "consec_deaths", "spawned_at", "respawn_at", "respawns",
+                 "gone")
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self.name = f"r{index}"
+        self.proc: Optional[subprocess.Popen] = None
+        self.port = 0
+        self.base_url = ""
+        self.consec_deaths = 0
+        self.spawned_at = 0.0
+        self.respawn_at = 0.0
+        self.respawns = 0
+        self.gone = False            # crash-looped past the spawn budget
+
+
+def default_replica_cmd(index: int, name: str,
+                        serve_argv: List[str]) -> List[str]:
+    return [sys.executable, "-m", "abpoa_tpu.cli", "serve",
+            "--host", "127.0.0.1", "--port", "0"] + serve_argv
+
+
+class FleetSupervisor:
+    """Owns the router + N replica processes until the fleet drains.
+
+    `replica_cmd(index, name, serve_argv) -> argv` is injectable so
+    tests can supervise a fake replica (anything that prints the
+    "listening on http://host:port" line on stderr and serves HTTP)
+    without paying serve startup N times.
+    """
+
+    def __init__(self, n: int, serve_argv: Optional[List[str]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 replica_cmd: Optional[Callable] = None,
+                 archive_base: Optional[str] = None,
+                 timeout_s: float = 75.0) -> None:
+        if n < 2:
+            raise ValueError("a fleet needs --replicas >= 2")
+        self.n = n
+        self.serve_argv = list(serve_argv or [])
+        self.replica_cmd = replica_cmd or default_replica_cmd
+        self.archive_base = archive_base or archive.archive_dir()
+        self.router = FleetRouter(host=host, port=port, timeout_s=timeout_s)
+        self.router.health_extra = self._health_extra
+        self.replicas = [Replica(i) for i in range(n)]
+        self.stop_evt = threading.Event()
+        self.hup_evt = threading.Event()
+        self._rolling = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ health
+    def _health_extra(self) -> dict:
+        return {"fleet": {
+            "replicas": self.n,
+            "respawns": sum(r.respawns for r in self.replicas),
+            "rolling_restart": self._rolling,
+            "pids": {r.name: (r.proc.pid if r.proc else None)
+                     for r in self.replicas},
+        }}
+
+    # ------------------------------------------------------------ spawn
+    def _spawn(self, r: Replica) -> None:
+        env = dict(os.environ)
+        env["ABPOA_TPU_REPLICA"] = r.name
+        env["ABPOA_TPU_ARCHIVE_DIR"] = os.path.join(
+            self.archive_base, f"replica-{r.name}")
+        cmd = self.replica_cmd(r.index, r.name, self.serve_argv)
+        try:
+            r.proc = subprocess.Popen(cmd, env=env, text=True,
+                                      stderr=subprocess.PIPE)
+        except OSError as e:
+            print(f"[abpoa-tpu fleet] {r.name}: spawn failed: {e}",
+                  file=sys.stderr)
+            r.proc = None
+            r.consec_deaths += 1
+            r.respawn_at = (time.monotonic()
+                            + restart_backoff_s(r.consec_deaths))
+            return
+        r.port = 0
+        r.base_url = ""
+        r.spawned_at = time.monotonic()
+        threading.Thread(target=self._pump_stderr, args=(r, r.proc),
+                         daemon=True,
+                         name=f"abpoa-fleet-stderr-{r.name}").start()
+
+    def _pump_stderr(self, r: Replica, proc: subprocess.Popen) -> None:
+        # forward replica stderr under its name; the first "listening on"
+        # line is the port handshake that puts the replica into placement
+        assert proc.stderr is not None
+        for line in proc.stderr:
+            line = line.rstrip("\n")
+            m = _LISTEN_RE.search(line)
+            if m and not r.base_url and proc is r.proc:
+                r.port = int(m.group(2))
+                r.base_url = f"http://{m.group(1)}:{r.port}"
+                self.router.set_replica(r.name, r.base_url, pid=proc.pid)
+            print(f"[{r.name}] {line}", file=sys.stderr)
+
+    # ------------------------------------------------------------ deaths
+    def _on_death(self, r: Replica, rc: Optional[int],
+                  expected: bool = False) -> None:
+        self.router.drop_replica(r.name)
+        now = time.monotonic()
+        if expected or now - r.spawned_at > _STABLE_S:
+            r.consec_deaths = 1
+        else:
+            r.consec_deaths += 1
+        r.proc = None
+        r.respawns += 1
+        if not expected and r.consec_deaths > MAX_SPAWN_FAILURES:
+            # the pool's spawn budget: a replica that can't survive its
+            # own startup is quarantined so the rest of the fleet keeps
+            # serving instead of burning CPU on a crash loop
+            r.gone = True
+            print(f"[abpoa-tpu fleet] {r.name}: died {r.consec_deaths}x "
+                  "in a row during startup — giving up on this replica "
+                  "slot (fleet continues degraded)", file=sys.stderr)
+            return
+        backoff = 0.0 if expected else restart_backoff_s(r.consec_deaths)
+        r.respawn_at = now + backoff
+        print(f"[abpoa-tpu fleet] {r.name}: "
+              + ("drained for restart" if expected
+                 else f"died rc={rc} (respawn in {backoff:.1f}s, "
+                      f"attempt {r.consec_deaths})"),
+              file=sys.stderr)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        limit = stall_s()
+        for r in self.replicas:
+            if r.gone:
+                continue
+            if r.proc is None:
+                if now >= r.respawn_at:
+                    self._spawn(r)
+                continue
+            rc = r.proc.poll()
+            if rc is not None:
+                self._on_death(r, rc)
+                continue
+            if limit > 0 and r.base_url and now - r.spawned_at > limit:
+                view = next((v for v in self.router.views()
+                             if v.name == r.name), None)
+                last = max(view.last_ok if view else 0.0, r.spawned_at)
+                if now - last > limit:
+                    print(f"[abpoa-tpu fleet] {r.name}: wedged "
+                          f"(no heartbeat for {now - last:.0f}s) — "
+                          "SIGKILL + respawn", file=sys.stderr)
+                    try:
+                        r.proc.kill()
+                    except OSError:
+                        pass
+
+    # ------------------------------------------------------------ rolling
+    def _alive(self) -> List[Replica]:
+        return [r for r in self.replicas if not r.gone]
+
+    def _wait_ready(self, name: str, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self.stop_evt.is_set():
+            if any(v.name == name and v.ready and not v.draining
+                   for v in self.router.views()):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def rolling_restart(self, ready_timeout: float = 300.0) -> None:
+        """Drain + respawn one replica at a time; the next drain waits
+        for the previous replica to be READY again, so the fleet never
+        serves with fewer than N-1 ready replicas."""
+        self._rolling = True
+        try:
+            for r in self._alive():
+                if self.stop_evt.is_set():
+                    return
+                proc = r.proc
+                if proc is None:
+                    continue
+                self.router.mark_draining(r.name, True)
+                try:
+                    proc.send_signal(signal.SIGHUP)
+                except OSError:
+                    pass
+                try:
+                    proc.wait(timeout=_DRAIN_GRACE_S)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                self._on_death(r, 0, expected=True)
+                self._spawn(r)
+                if not self._wait_ready(r.name, ready_timeout):
+                    print(f"[abpoa-tpu fleet] {r.name}: not ready "
+                          f"{ready_timeout:.0f}s after rolling respawn — "
+                          "halting the rolling restart (fleet stays at "
+                          "current strength)", file=sys.stderr)
+                    return
+                print(f"[abpoa-tpu fleet] {r.name}: rolled", file=sys.stderr)
+        finally:
+            self._rolling = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self.router.start()
+        for r in self.replicas:
+            self._spawn(r)
+
+    def shutdown(self) -> None:
+        """Fleet drain: stop router admissions, SIGTERM every replica,
+        wait for their graceful drains (hard-kill past the grace)."""
+        self.stop_evt.set()
+        self.router.begin_drain()
+        procs = [(r, r.proc) for r in self.replicas if r.proc is not None]
+        for _r, p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + _DRAIN_GRACE_S
+        for r, p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            r.proc = None
+        self.router.stop()
+
+    def run_forever(self, tick_s: float = 0.2) -> None:
+        while not self.stop_evt.is_set():
+            if self.hup_evt.is_set() and not self._rolling:
+                self.hup_evt.clear()
+                threading.Thread(target=self.rolling_restart, daemon=True,
+                                 name="abpoa-fleet-rolling").start()
+            self._tick()
+            self.stop_evt.wait(tick_s)
+
+
+def fleet_main(argv) -> int:
+    """`abpoa-tpu fleet` (also `serve --replicas N`) — supervise N serve
+    replicas behind the failover router until SIGTERM, then drain the
+    whole fleet and exit 0. SIGHUP rolling-restarts one replica at a
+    time, never dropping below N-1 ready."""
+    ap = _build_parser()
+    ap.prog = "abpoa-tpu fleet"
+    args = ap.parse_args(argv)
+    n = args.replicas if args.replicas is not None else 2
+    try:
+        sup = FleetSupervisor(n, serve_argv=_replica_argv(list(argv)),
+                              host=args.host, port=args.port)
+    except (ValueError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # router bind failures surface as OSError
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+    def _on_stop(signum, _frame):
+        print(f"[abpoa-tpu fleet] signal {signum}: draining the fleet",
+              file=sys.stderr)
+        sup.stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_stop)
+    signal.signal(signal.SIGINT, _on_stop)
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP,
+                      lambda *_: sup.hup_evt.set())
+    # router socket is bound in the FleetRouter constructor, so this line
+    # is authoritative — printed before any replica is ready, same
+    # contract as serve's own listening line
+    print(f"[abpoa-tpu fleet] listening on "
+          f"http://{sup.router.host}:{sup.router.port} "
+          f"(replicas={n}, archive base={sup.archive_base})",
+          file=sys.stderr, flush=True)
+    sup.start()
+
+    metrics_stop: Optional[threading.Event] = None
+    if args.metrics is not None:
+        path = args.metrics or obs.metrics.default_textfile_path()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        metrics_stop = threading.Event()
+
+        def _roll():
+            while not metrics_stop.wait(2.0):
+                try:
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as fp:
+                        fp.write(sup.router.merged_exposition())
+                    os.replace(tmp, path)
+                except OSError:
+                    pass
+
+        threading.Thread(target=_roll, daemon=True,
+                         name="abpoa-fleet-metrics").start()
+
+    try:
+        sup.run_forever()
+    finally:
+        sup.shutdown()
+        if metrics_stop is not None:
+            metrics_stop.set()
+            try:
+                with open(path, "w") as fp:
+                    fp.write(obs.metrics.registry().render())
+            except OSError:
+                pass
+    routed = sup.router.stats()
+    total = sum(routed.values())
+    print(f"[abpoa-tpu fleet] drained clean: {total} requests "
+          + " ".join(f"{k}={v}" for k, v in sorted(routed.items()))
+          + f"  respawns={sum(r.respawns for r in sup.replicas)}",
+          file=sys.stderr)
+    return 0

@@ -1,0 +1,571 @@
+"""Fleet router: the HTTP front that makes N serve replicas one service.
+
+One stdlib ThreadingHTTPServer forwards ``POST /align`` to ready
+replicas; everything that makes a fleet better than N ports is here:
+
+- **placement**: ready replicas ranked by observed load (the
+  `abpoa_serve_queue_depth`/inflight the health poller scrapes, plus the
+  router's own in-flight deltas — the same queue-pressure inputs
+  `scheduler.plan_route` weighs), with compile-rung affinity as the
+  tie-break so same-rung requests keep hitting warm caches.
+- **failover**: a transport error (connection reset, replica death
+  mid-request) triggers exactly ONE retry on a sibling, re-sent under
+  the SAME request id with the attempt number bumped — both replicas'
+  archives record their attempt, and `abpoa-tpu why --fleet` narrates
+  the hop. Alignment is pure, so a duplicate execution is harmless; the
+  first terminal response wins and the loser is read and discarded.
+- **hedged retries**: past a latency-sketch-derived delay (p95-based,
+  ABPOA_TPU_FLEET_HEDGE_S overrides) a single duplicate goes to the next
+  candidate; first response wins, the duplicate's answer is discarded
+  idempotently. Bounded: at most one hedge per request, never while a
+  failover is already in flight.
+- **shed propagation**: a replica's 429/503 spills the request to the
+  next untried candidate; when every candidate sheds, the LAST shed
+  response's status and Retry-After propagate verbatim — the fleet's
+  backpressure story is exactly the single process's.
+- connection semantics match the single-process path bit for bit:
+  draining 503 / malformed Content-Length 400 / oversized 413 are
+  answered by the ROUTER with `Connection: close` (the body was never
+  read); proxied responses keep the connection alive (the router always
+  read the client body first), so a keep-alive client can never desync
+  through the proxy hop.
+
+`GET /metrics` answers the FLEET exposition: every ready replica's
+scrape merged with the router's own families through
+`metrics.merge_expositions` — counters sum, LogSketch histograms merge
+bucket-wise, quantile gauges are recomputed from the merged sketch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import obs
+from ..obs import metrics
+from .server import _inbound_rid, max_body_bytes
+
+
+def poll_interval_s() -> float:
+    return float(os.environ.get("ABPOA_TPU_FLEET_POLL_S", "0.3"))
+
+
+def hedge_delay_s(sketch) -> Optional[float]:
+    """When to launch the straggler hedge: ABPOA_TPU_FLEET_HEDGE_S forces
+    a delay ("off"/"0" disables); otherwise 2x the router's observed p95
+    once the sketch has enough mass to mean anything. None = no hedging
+    (cold router: better no hedge than a hedge storm at the wrong
+    threshold)."""
+    env = os.environ.get("ABPOA_TPU_FLEET_HEDGE_S")
+    if env is not None:
+        env = env.strip().lower()
+        if env in ("", "0", "off", "none"):
+            return None
+        return float(env)
+    if sketch.count < 20:
+        return None
+    return max(0.05, 2.0 * sketch.quantile(0.95))
+
+
+def _body_rung(body: bytes) -> Optional[int]:
+    """Placement-affinity rung from the raw request body: the longest
+    non-header line approximates qmax well enough to pick the replica
+    whose compile cache is already warm at that rung (jax-free, like
+    admission's own pricing)."""
+    try:
+        from ..compile.ladder import qp_rung
+        qmax = max((len(ln) for ln in body.split(b"\n")
+                    if ln and not ln.startswith((b">", b"@", b"+", b";"))),
+                   default=0)
+        return qp_rung(max(1, qmax)) if qmax else None
+    except Exception:
+        return None
+
+
+class ReplicaView:
+    """The router's health-poller view of one replica."""
+
+    __slots__ = ("name", "base_url", "pid", "ready", "draining",
+                 "queue_depth", "inflight", "local_inflight", "last_rung",
+                 "last_ok", "health")
+
+    def __init__(self, name: str, base_url: str, pid: int = 0) -> None:
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.pid = pid
+        self.ready = False
+        self.draining = False
+        self.queue_depth = 0
+        self.inflight = 0
+        self.local_inflight = 0     # router-launched, not yet answered
+        self.last_rung: Optional[int] = None
+        self.last_ok = 0.0          # monotonic ts of the last health poll
+        self.health: dict = {}
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "url": self.base_url, "pid": self.pid,
+                "ready": self.ready, "draining": self.draining,
+                "queue_depth": self.queue_depth, "inflight": self.inflight,
+                "local_inflight": self.local_inflight}
+
+
+def plan_placement(views: List[ReplicaView],
+                   rung: Optional[int] = None) -> List[ReplicaView]:
+    """Candidate order for one request: ready, non-draining replicas by
+    ascending observed load (scraped queue depth + inflight + the
+    router's own unanswered sends), rung affinity breaking ties."""
+    ready = [v for v in views if v.ready and not v.draining]
+
+    def key(v: ReplicaView):
+        affinity = 0 if (rung is not None and v.last_rung == rung) else 1
+        return (v.queue_depth + v.inflight + v.local_inflight,
+                affinity, v.name)
+
+    return sorted(ready, key=key)
+
+
+class _Outcome:
+    """One routed request's terminal answer."""
+
+    __slots__ = ("code", "body", "headers", "replica", "attempt",
+                 "failovers", "hedges", "hedge_won")
+
+    def __init__(self, code: int, body: bytes, headers: Dict[str, str],
+                 replica: str = "", attempt: int = 1, failovers: int = 0,
+                 hedges: int = 0, hedge_won: bool = False) -> None:
+        self.code = code
+        self.body = body
+        self.headers = headers
+        self.replica = replica
+        self.attempt = attempt
+        self.failovers = failovers
+        self.hedges = hedges
+        self.hedge_won = hedge_won
+
+
+class FleetRouter:
+    """Owns the front socket, the replica views and the health poller.
+    The fleet supervisor (serve/fleet.py) registers replicas as it spawns
+    them and re-registers on respawn (new port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 75.0) -> None:
+        self.timeout_s = timeout_s
+        self.draining = threading.Event()
+        self._views: Dict[str, ReplicaView] = {}
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+        self.health_extra: Optional[Callable[[], dict]] = None
+        reg = metrics.registry()
+        self._c_requests = reg.counter(
+            "abpoa_fleet_requests_total",
+            "Routed fleet requests by terminal status")
+        self._c_failovers = reg.counter(
+            "abpoa_fleet_failovers_total",
+            "Requests re-sent to a sibling after a replica transport "
+            "failure (exactly once per request)")
+        self._c_hedges = reg.counter(
+            "abpoa_fleet_hedges_total",
+            "Straggler hedges launched (duplicate send, first wins)")
+        self._c_hedge_wins = reg.counter(
+            "abpoa_fleet_hedge_wins_total",
+            "Hedged duplicates that answered before the primary")
+        self._c_spills = reg.counter(
+            "abpoa_fleet_shed_spills_total",
+            "Requests spilled to a sibling after a replica shed (429/503)")
+        self._g_ready = reg.gauge(
+            "abpoa_fleet_replicas_ready",
+            "Replicas currently passing /readyz")
+        self._hist = reg.histogram(
+            "abpoa_fleet_request_seconds",
+            "Router-side end-to-end request latency (log-bucket sketch, "
+            f"~{int(metrics.LogSketch.RELATIVE_ERROR * 100)}% quantile "
+            "tolerance)")
+        self.sketch = self._hist.sketch
+        from http.server import ThreadingHTTPServer
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            request_queue_size = 128  # same shed-not-reset story as serve
+
+        self._httpd = _Server((host, port), _make_router_handler(self))
+        self.host, self.port = self._httpd.server_address[:2]
+        self._poll_stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="abpoa-fleet-http").start()
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="abpoa-fleet-poller")
+        self._poller.start()
+
+    def begin_drain(self) -> None:
+        self.draining.set()
+
+    def stop(self) -> None:
+        self._poll_stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------ replicas
+    def set_replica(self, name: str, base_url: str, pid: int = 0) -> None:
+        """Register/replace one replica endpoint (respawn = new port)."""
+        with self._lock:
+            self._views[name] = ReplicaView(name, base_url, pid)
+
+    def drop_replica(self, name: str) -> None:
+        with self._lock:
+            self._views.pop(name, None)
+
+    def mark_draining(self, name: str, draining: bool) -> None:
+        """Rolling restart: take one replica out of placement before its
+        SIGHUP so no request races the drain window."""
+        with self._lock:
+            v = self._views.get(name)
+            if v is not None:
+                v.draining = draining
+
+    def views(self) -> List[ReplicaView]:
+        with self._lock:
+            return list(self._views.values())
+
+    def ready_count(self) -> int:
+        return sum(1 for v in self.views() if v.ready and not v.draining)
+
+    # ------------------------------------------------------------ polling
+    def _poll_once(self, v: ReplicaView) -> None:
+        try:
+            with urllib.request.urlopen(v.base_url + "/readyz",
+                                        timeout=2.0) as r:
+                ready = r.status == 200
+                r.read()
+        except urllib.error.HTTPError as e:
+            e.read()
+            ready = False
+        except (urllib.error.URLError, OSError):
+            v.ready = False
+            return
+        try:
+            with urllib.request.urlopen(v.base_url + "/healthz",
+                                        timeout=2.0) as r:
+                doc = json.loads(r.read().decode())
+            v.queue_depth = int(doc.get("queue_depth") or 0)
+            v.inflight = int(doc.get("inflight") or 0)
+            v.health = doc
+            v.last_ok = time.monotonic()
+        except (urllib.error.URLError, OSError, ValueError):
+            v.ready = False
+            return
+        v.ready = ready
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(poll_interval_s()):
+            for v in self.views():
+                self._poll_once(v)
+            self._g_ready.set(self.ready_count())
+
+    def poll_now(self) -> None:
+        """One synchronous poll sweep (tests, startup)."""
+        for v in self.views():
+            self._poll_once(v)
+        self._g_ready.set(self.ready_count())
+
+    # ------------------------------------------------------------ stats
+    def bump(self, status: str) -> None:
+        with self._lock:
+            self._stats[status] = self._stats.get(status, 0) + 1
+        self._c_requests.inc(1, status=status)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def health(self) -> dict:
+        out = {"status": ("draining" if self.draining.is_set() else "ok"),
+               "role": "fleet-router",
+               "replicas": [v.snapshot() for v in self.views()],
+               "ready": self.ready_count(),
+               "routed": self.stats()}
+        if self.health_extra is not None:
+            try:
+                out.update(self.health_extra())
+            except Exception:
+                pass
+        return out
+
+    def merged_exposition(self) -> str:
+        """The fleet /metrics body: every ready replica's scrape merged
+        with the router's own registry."""
+        texts = []
+        for v in self.views():
+            try:
+                with urllib.request.urlopen(v.base_url + "/metrics",
+                                            timeout=2.0) as r:
+                    texts.append(r.read().decode())
+            except (urllib.error.URLError, OSError):
+                continue
+        texts.append(metrics.registry().render())
+        try:
+            return metrics.merge_expositions(texts)
+        except ValueError:
+            # one torn scrape must not blank the endpoint
+            return metrics.registry().render()
+
+    # ------------------------------------------------------------ routing
+    def _post_replica(self, v: ReplicaView, body: bytes,
+                      fwd: Dict[str, str], rid: str,
+                      attempt: int) -> Tuple[str, int, bytes, Dict]:
+        req = urllib.request.Request(
+            v.base_url + "/align", data=body, method="POST",
+            headers={**fwd, "X-Abpoa-Request-Id": rid,
+                     "X-Abpoa-Attempt": str(attempt)})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return ("http", r.status, r.read(), dict(r.headers))
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            return ("http", e.code, data, dict(e.headers))
+        except (urllib.error.URLError, OSError) as e:
+            # RemoteDisconnected subclasses ConnectionResetError; urllib
+            # wraps most socket deaths in URLError — all of them mean the
+            # replica never delivered a status line: failover material
+            return ("transport", 0, b"", {"error": str(e)})
+
+    def route(self, body: bytes, fwd: Dict[str, str], rid: str) -> _Outcome:
+        """Race one request to a terminal answer across the fleet. The
+        winner is the first non-shed HTTP response; transport errors
+        trigger the exactly-once failover, sheds spill to untried
+        siblings, and one bounded hedge covers stragglers."""
+        t0 = time.perf_counter()
+        rung = _body_rung(body)
+        resq: "queue.Queue" = queue.Queue()
+        outstanding = 0
+        attempts = 0
+        failovers = hedges = spills = 0
+        tried: set = set()
+        shed: List[Tuple[int, bytes, Dict]] = []
+        lost_transport = 0
+
+        def launch(v: ReplicaView, attempt_no: int, kind: str) -> None:
+            nonlocal outstanding, attempts
+            outstanding += 1
+            attempts = max(attempts, attempt_no)
+            tried.add(v.name)
+            with self._lock:
+                v.local_inflight += 1
+
+            def run():
+                res = self._post_replica(v, body, fwd, rid, attempt_no)
+                with self._lock:
+                    v.local_inflight = max(0, v.local_inflight - 1)
+                resq.put((v, attempt_no, kind, res))
+
+            threading.Thread(target=run, daemon=True,
+                             name=f"abpoa-fleet-{kind}").start()
+
+        def next_candidate() -> Optional[ReplicaView]:
+            for v in plan_placement(self.views(), rung):
+                if v.name not in tried:
+                    return v
+            return None
+
+        first = plan_placement(self.views(), rung)
+        if not first:
+            return _Outcome(503, b"", {"Retry-After": "5"},
+                            failovers=0, hedges=0)
+        launch(first[0], 1, "primary")
+        hedge_after = hedge_delay_s(self.sketch)
+        hedge_done = hedge_after is None
+
+        while outstanding > 0:
+            timeout: Optional[float] = None
+            if not hedge_done:
+                remaining = (t0 + hedge_after) - time.perf_counter()
+                if remaining <= 0:
+                    hedge_done = True
+                    cand = next_candidate()
+                    # never hedge on top of an in-flight failover: the
+                    # retry is already the second copy
+                    if cand is not None and failovers == 0:
+                        hedges += 1
+                        self._c_hedges.inc()
+                        launch(cand, attempts + 1, "hedge")
+                    continue
+                timeout = remaining
+            try:
+                v, attempt_no, kind, (tk, code, rbody, rheaders) = \
+                    resq.get(timeout=timeout)
+            except queue.Empty:
+                continue
+            outstanding -= 1
+            if tk == "transport":
+                lost_transport += 1
+                if failovers == 0:
+                    cand = next_candidate()
+                    if cand is None:
+                        # nowhere untried left — a sibling that only shed
+                        # may still accept the retry
+                        ready = [w for w in
+                                 plan_placement(self.views(), rung)
+                                 if w.name != v.name]
+                        cand = ready[0] if ready else None
+                    if cand is not None:
+                        failovers += 1
+                        self._c_failovers.inc()
+                        launch(cand, attempt_no + 1, "failover")
+                        continue
+                if outstanding:
+                    continue
+                break
+            if code in (429, 503):
+                shed.append((code, rbody, rheaders))
+                cand = next_candidate()
+                if cand is not None:
+                    spills += 1
+                    self._c_spills.inc()
+                    launch(cand, attempt_no + 1, "spill")
+                    continue
+                if outstanding:
+                    continue
+                break
+            # terminal answer: first writer wins; outstanding duplicates
+            # drain in their daemon threads and are discarded
+            self.sketch.observe(time.perf_counter() - t0)
+            if kind == "hedge":
+                self._c_hedge_wins.inc()
+            replica = rheaders.get("X-Abpoa-Replica") or v.name
+            v.last_rung = rung
+            return _Outcome(code, rbody, rheaders, replica=replica,
+                            attempt=attempt_no, failovers=failovers,
+                            hedges=hedges, hedge_won=(kind == "hedge"))
+        # no replica produced a terminal answer
+        if shed:
+            code, rbody, rheaders = shed[-1]
+            return _Outcome(code, rbody, rheaders, failovers=failovers,
+                            hedges=hedges)
+        return _Outcome(
+            502, (json.dumps({"error": "replica connection lost and no "
+                                       "sibling available"}) + "\n")
+            .encode(), {"Content-Type": "application/json"},
+            failovers=failovers, hedges=hedges)
+
+
+# --------------------------------------------------------------------------- #
+# HTTP front                                                                  #
+# --------------------------------------------------------------------------- #
+
+# client request headers forwarded to the replica verbatim
+_FWD_REQUEST = ("Content-Type", "X-Abpoa-Deadline-S")
+# replica response headers forwarded to the client verbatim
+_FWD_RESPONSE = ("Content-Type", "Retry-After", "X-Abpoa-Reads")
+
+
+def _make_router_handler(router: FleetRouter):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _send(self, code: int, body: bytes, ctype: str,
+                  headers: Optional[dict] = None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, val in (headers or {}).items():
+                self.send_header(k, val)
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None) -> None:
+            self._send(code, (json.dumps(obj) + "\n").encode(),
+                       "application/json", headers)
+
+        def log_message(self, *a):
+            pass
+
+        # -------------------------------------------------------- GET
+        def do_GET(self):  # noqa: N802 — http.server API
+            path = self.path.rstrip("/")
+            if path == "/healthz":
+                self._json(200, router.health())
+            elif path == "/readyz":
+                if router.draining.is_set():
+                    self._json(503, {"status": "draining"})
+                elif router.ready_count() == 0:
+                    self._json(503, {"status": "no ready replicas"})
+                else:
+                    self._json(200, {"status": "ready",
+                                     "replicas": router.ready_count()})
+            elif path == "/metrics":
+                self._send(200, router.merged_exposition().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+
+        # -------------------------------------------------------- POST
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path.rstrip("/") != "/align":
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+                return
+            # ingress id, minted here so every delivery attempt across
+            # replicas shares one id (the client may also supply its own)
+            rid = (_inbound_rid(self.headers.get("X-Abpoa-Request-Id"))
+                   or obs.new_request_id())
+            rh = {"X-Abpoa-Request-Id": rid}
+            # the three body-unread dispositions mirror serve/server.py
+            # exactly: same codes, same Retry-After, same Connection:
+            # close (an unread body on a keep-alive socket would parse
+            # as the next request line)
+            if router.draining.is_set():
+                self.close_connection = True
+                router.bump("draining")
+                self._json(503, {"error": "fleet is draining"},
+                           {"Retry-After": "30", **rh})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                self.close_connection = True
+                router.bump("poisoned")
+                self._json(400, {"error": "malformed Content-Length"}, rh)
+                return
+            if n > max_body_bytes():
+                self.close_connection = True
+                router.bump("oversized")
+                self._json(413, {"error": f"body {n} B exceeds the "
+                                          f"{max_body_bytes()} B limit"},
+                           rh)
+                return
+            raw = self.rfile.read(n) if n else b""
+            fwd = {k: self.headers[k] for k in _FWD_REQUEST
+                   if self.headers.get(k)}
+            out = router.route(raw, fwd, rid)
+            status_key = {200: "ok", 429: "shed", 503: "shed",
+                          400: "poisoned", 504: "timeout"}.get(
+                out.code, "error" if out.code >= 500 else "other")
+            router.bump(status_key)
+            headers = {k: out.headers[k] for k in _FWD_RESPONSE
+                       if out.headers.get(k)}
+            headers.update(rh)
+            if out.replica:
+                headers["X-Abpoa-Replica"] = out.replica
+            headers["X-Abpoa-Attempt"] = str(out.attempt)
+            headers["X-Abpoa-Failovers"] = str(out.failovers)
+            headers["X-Abpoa-Hedges"] = str(out.hedges)
+            ctype = headers.pop("Content-Type",
+                                out.headers.get("Content-Type")
+                                or "application/json")
+            self._send(out.code, out.body, ctype, headers)
+
+    return Handler

@@ -42,6 +42,7 @@ import copy
 import io
 import json
 import os
+import re
 import signal
 import sys
 import threading
@@ -82,6 +83,28 @@ def _test_delay_s() -> float:
     return float(os.environ.get("ABPOA_TPU_SERVE_DELAY_S", "0"))
 
 
+def replica_name() -> Optional[str]:
+    """This process's fleet replica name (ABPOA_TPU_REPLICA, set by the
+    fleet supervisor at spawn). None outside a fleet."""
+    return os.environ.get("ABPOA_TPU_REPLICA") or None
+
+
+# inbound request ids (fleet router hop) must look like our own minted
+# ids: hex-ish tokens, bounded — anything else is ignored and re-minted
+_RID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+
+def _inbound_rid(hdr: Optional[str]) -> Optional[str]:
+    return hdr if hdr and _RID_RE.match(hdr) else None
+
+
+def _inbound_attempt(hdr: Optional[str]) -> int:
+    try:
+        return max(1, min(99, int(hdr or 1)))
+    except ValueError:
+        return 1
+
+
 def _request_record(job: Job, status: str, device: str) -> dict:
     """One archive record per terminal request — the field shapes
     `obs/slo.py` evaluates (reads, read_wall_ms, faults, total_wall_s),
@@ -91,7 +114,7 @@ def _request_record(job: Job, status: str, device: str) -> dict:
     wall = job.wall_s()
     per_read_ms = (round(1e3 * wall / job.n_reads, 4) if job.n_reads
                    and status == "ok" else None)
-    return {
+    rec = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "kind": "serve_request",
         "label": job.label,
@@ -106,6 +129,11 @@ def _request_record(job: Job, status: str, device: str) -> dict:
         "faults": 1 if status in ("poisoned", "timeout", "error") else 0,
         "quarantined": 1 if status == "poisoned" else 0,
     }
+    rep = replica_name()
+    if rep:
+        rec["replica"] = rep
+    rec["attempt"] = job.attempt
+    return rec
 
 
 class AlignServer:
@@ -332,6 +360,10 @@ class AlignServer:
                "queue_depth": depth, "inflight": inflight,
                "served": self.stats(), "device": self.abpt.device,
                "uptime_s": round(time.time() - self.t_start, 1)}
+        rep = replica_name()
+        if rep:
+            out["replica"] = rep
+            out["pid"] = os.getpid()
         if self._pool is not None:
             # worker pids included so an operator (or the smoke harness)
             # can kill a worker and watch the supervisor respawn it
@@ -663,9 +695,18 @@ def _make_handler(server: AlignServer):
             # the request id is minted at INGRESS — before parsing, before
             # admission — and every disposition (shed, poisoned, served)
             # answers with it, so a client-side latency outlier is
-            # directly greppable into traces/dumps/archive records
-            rid = obs.new_request_id()
-            rh = {"X-Abpoa-Request-Id": rid}
+            # directly greppable into traces/dumps/archive records. A
+            # fleet router hop carries the id it already minted (plus the
+            # attempt number) so failover/hedge deliveries share one id
+            # across replica archives.
+            rid = (_inbound_rid(self.headers.get("X-Abpoa-Request-Id"))
+                   or obs.new_request_id())
+            attempt = _inbound_attempt(self.headers.get("X-Abpoa-Attempt"))
+            rh = {"X-Abpoa-Request-Id": rid,
+                  "X-Abpoa-Attempt": str(attempt)}
+            rep = replica_name()
+            if rep:
+                rh["X-Abpoa-Replica"] = rep
             if server.draining.is_set():
                 # the body was never read: close the connection, or a
                 # keep-alive client's unread bytes would parse as its
@@ -693,7 +734,7 @@ def _make_handler(server: AlignServer):
             raw = self.rfile.read(n) if n else b""
             t0 = time.perf_counter()
             try:
-                job = self._parse_job(raw, rid)
+                job = self._parse_job(raw, rid, attempt)
             except Exception as e:  # malformed body: 400, never a crash
                 server.bump("poisoned", time.perf_counter() - t0)
                 obs.record_fault("poisoned_set", detail=str(e)[:300],
@@ -738,7 +779,8 @@ def _make_handler(server: AlignServer):
                 self._json(500, {"error": job.error or "internal error"},
                            rh)
 
-        def _parse_job(self, raw: bytes, rid: str = "") -> Job:
+        def _parse_job(self, raw: bytes, rid: str = "",
+                       attempt: int = 1) -> Job:
             from ..io.fastx import read_fastx_text
             from ..resilience import validate_records
             from ..resilience.memory import estimate_bytes
@@ -760,7 +802,7 @@ def _make_handler(server: AlignServer):
             return Job(records, rung=qp_rung(qmax),
                        est_bytes=estimate_bytes(caps),
                        eligible=fused_eligible(server.abpt, len(records)),
-                       deadline_s=deadline, rid=rid)
+                       deadline_s=deadline, rid=rid, attempt=attempt)
 
     return Handler
 
@@ -786,6 +828,11 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--workers", type=int,
                     default=min(4, os.cpu_count() or 1),
                     help="alignment worker threads [%(default)s]")
+    ap.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="run N supervised serve replicas behind a "
+                         "failover router instead of one process "
+                         "(serve/fleet.py); SIGHUP rolling-restarts the "
+                         "fleet [single process]")
     ap.add_argument("--pool-workers", type=int, default=None, metavar="N",
                     help="execute requests in N supervised worker "
                          "PROCESSES (parallel/pool.py): crash "
@@ -862,6 +909,10 @@ def serve_main(argv) -> int:
     drain: stop admitting (503), finish in-flight, flush metrics and the
     report archive, exit 0."""
     args = _build_parser().parse_args(argv)
+    if args.replicas is not None and args.replicas > 1:
+        # multi-replica service: same flags, fleet supervisor + router
+        from .fleet import fleet_main
+        return fleet_main(argv)
     try:
         abpt = _params_from_args(args).finalize()
     except ValueError as e:
@@ -890,6 +941,11 @@ def serve_main(argv) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    if hasattr(signal, "SIGHUP"):
+        # the fleet's rolling restart drains one replica at a time with
+        # SIGHUP: for a single process it is the same graceful drain the
+        # LB-friendly SIGTERM path runs (finish in-flight, then exit 0)
+        signal.signal(signal.SIGHUP, _on_signal)
     try:
         # the line operators (and the smoke harness) wait for: the bind
         # already happened in the constructor, so the port is

@@ -1,0 +1,671 @@
+"""Fleet tests (ISSUE 16): replica placement, exactly-once failover,
+hedged retries, shed/Retry-After propagation through the proxy hop,
+rolling restarts that never drop below N-1 ready, supervisor respawn,
+and the end-to-end chaos path (`serve --replicas 2`, SIGKILL one replica
+mid-request, every response still a 200 byte-identical to the oracle,
+`abpoa-tpu why` names the hop, SIGTERM drains the fleet rc=0).
+
+Router mechanics run against in-process STUB replicas (scripted 200 /
+shed / connection-reset behaviors — no serve startup cost); supervisor
+mechanics run against a fake replica subprocess that speaks just enough
+of the serve contract (listening line, /readyz, /healthz, SIGTERM/SIGHUP
+exit); one subprocess test runs the real thing because signals, exit
+codes and archive layout ARE the contract."""
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from conftest import DATA_DIR
+
+TEST_FA = os.path.join(DATA_DIR, "test.fa")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------- #
+# stub replicas: scripted POST /align behaviors behind the real          #
+# readyz/healthz/metrics surface the router polls                        #
+# --------------------------------------------------------------------- #
+
+class StubReplica:
+    """mode: 'ok' answers 200 (after `delay`), 'shed' answers 429 with
+    `retry_after`, 'reset' reads the body then drops the connection
+    without a status line (what a SIGKILLed replica looks like)."""
+
+    def __init__(self, name, mode="ok", delay=0.0, retry_after="7",
+                 queue_depth=0):
+        self.name = name
+        self.mode = mode
+        self.delay = delay
+        self.retry_after = retry_after
+        self.queue_depth = queue_depth
+        self.seen = []          # (rid, attempt) per POST /align received
+        self._lock = threading.Lock()
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    self._send(200, b'{"status": "ready"}')
+                elif self.path == "/healthz":
+                    self._send(200, json.dumps(
+                        {"status": "ok", "queue_depth": stub.queue_depth,
+                         "inflight": 0, "replica": stub.name}).encode())
+                elif self.path == "/metrics":
+                    text = ("# HELP stub_requests_total served\n"
+                            "# TYPE stub_requests_total counter\n"
+                            f"stub_requests_total {len(stub.seen)}\n")
+                    self._send(200, text.encode())
+                else:
+                    self._send(404, b"{}")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                with stub._lock:
+                    stub.seen.append(
+                        (self.headers.get("X-Abpoa-Request-Id"),
+                         int(self.headers.get("X-Abpoa-Attempt") or 1)))
+                if stub.mode == "reset":
+                    # no status line, hard close: RemoteDisconnected at
+                    # the router — the failover trigger
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
+                if stub.mode == "shed":
+                    self._send(429, b'{"error": "shed"}\n',
+                               {"Retry-After": stub.retry_after})
+                    return
+                if stub.delay:
+                    time.sleep(stub.delay)
+                self._send(200, json.dumps(
+                    {"served_by": stub.name}).encode() + b"\n",
+                    {"X-Abpoa-Replica": stub.name, "X-Abpoa-Reads": "3"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stub_router(monkeypatch):
+    """A FleetRouter over freshly-made stubs; yields a factory, cleans
+    everything up. Hedging defaults OFF so tests opt in explicitly."""
+    monkeypatch.setenv("ABPOA_TPU_FLEET_HEDGE_S", "off")
+    monkeypatch.setenv("ABPOA_TPU_FLEET_POLL_S", "0.1")
+    made = []
+
+    def make(*stubs, start_http=False, **kw):
+        from abpoa_tpu.serve.router import FleetRouter
+        r = FleetRouter(port=0, timeout_s=10.0, **kw)
+        for s in stubs:
+            r.set_replica(s.name, s.base)
+        r.poll_now()
+        if start_http:
+            r.start()
+        made.append((r, stubs, start_http))
+        return r
+
+    yield make
+    for r, stubs, started in made:
+        if started:
+            r.stop()
+        else:
+            r._poll_stop.set()
+            r._httpd.server_close()
+        for s in stubs:
+            s.close()
+
+
+# --------------------------------------------------------------------- #
+# placement                                                              #
+# --------------------------------------------------------------------- #
+
+def test_plan_placement_orders_by_load_then_rung_affinity():
+    from abpoa_tpu.serve.router import ReplicaView, plan_placement
+    a = ReplicaView("r0", "http://x:1")
+    b = ReplicaView("r1", "http://x:2")
+    c = ReplicaView("r2", "http://x:3")
+    for v in (a, b, c):
+        v.ready = True
+    a.queue_depth = 4                   # loaded
+    b.last_rung = 256                   # idle, warm at the target rung
+    c.local_inflight = 1                # one router send outstanding
+    order = [v.name for v in plan_placement([a, b, c], rung=256)]
+    assert order == ["r1", "r2", "r0"]
+    # rung affinity is only a tie-break: it never outranks load
+    b.queue_depth = 9
+    assert [v.name for v in plan_placement([a, b, c], rung=256)][0] == "r2"
+    # not-ready and draining replicas never place
+    c.draining = True
+    a.ready = False
+    assert [v.name for v in plan_placement([a, b, c], rung=256)] == ["r1"]
+
+
+def test_router_routes_to_ready_replica_with_attribution(stub_router):
+    s0 = StubReplica("r0")
+    r = stub_router(s0)
+    out = r.route(b">s\nACGT\n", {}, "rid-basic")
+    assert out.code == 200
+    assert out.replica == "r0" and out.attempt == 1
+    assert out.failovers == 0 and out.hedges == 0
+    assert s0.seen == [("rid-basic", 1)]
+
+
+def test_router_503_when_no_replica_ready(stub_router):
+    r = stub_router()          # no replicas registered at all
+    out = r.route(b">s\nACGT\n", {}, "rid-none")
+    assert out.code == 503
+    assert out.headers.get("Retry-After")
+
+
+# --------------------------------------------------------------------- #
+# failover                                                               #
+# --------------------------------------------------------------------- #
+
+def test_failover_exactly_once_same_rid_bumped_attempt(stub_router):
+    dead = StubReplica("r0", mode="reset")
+    live = StubReplica("r1", queue_depth=5)   # loaded: r0 places first
+    r = stub_router(dead, live)
+    out = r.route(b">s\nACGT\n", {}, "rid-fo")
+    assert out.code == 200 and out.replica == "r1"
+    assert out.failovers == 1 and out.attempt == 2
+    # exactly one delivery per replica, same id across the hop, attempt
+    # bumped on the retry — the idempotent-archive-record invariant
+    assert dead.seen == [("rid-fo", 1)]
+    assert live.seen == [("rid-fo", 2)]
+
+
+def test_failover_never_retries_twice(stub_router):
+    d0 = StubReplica("r0", mode="reset")
+    d1 = StubReplica("r1", mode="reset")
+    r = stub_router(d0, d1)
+    out = r.route(b">s\nACGT\n", {}, "rid-fo2")
+    assert out.code == 502           # both transports died, no third try
+    assert out.failovers == 1
+    assert len(d0.seen) + len(d1.seen) == 2
+
+
+# --------------------------------------------------------------------- #
+# shed propagation                                                       #
+# --------------------------------------------------------------------- #
+
+def test_all_shed_propagates_last_retry_after(stub_router):
+    s0 = StubReplica("r0", mode="shed", retry_after="7")
+    s1 = StubReplica("r1", mode="shed", retry_after="11", queue_depth=3)
+    r = stub_router(s0, s1)
+    out = r.route(b">s\nACGT\n", {}, "rid-shed")
+    assert out.code == 429
+    # spill order is r0 (idle) then r1; the propagated Retry-After is the
+    # final shedder's, verbatim
+    assert out.headers.get("Retry-After") == "11"
+    assert s0.seen == [("rid-shed", 1)] and s1.seen == [("rid-shed", 2)]
+
+
+def test_shed_spills_to_sibling_that_accepts(stub_router):
+    s0 = StubReplica("r0", mode="shed", retry_after="7")
+    s1 = StubReplica("r1", queue_depth=9)     # loaded but willing
+    r = stub_router(s0, s1)
+    out = r.route(b">s\nACGT\n", {}, "rid-spill")
+    assert out.code == 200 and out.replica == "r1"
+    assert out.failovers == 0                 # a shed is not a failover
+
+
+# --------------------------------------------------------------------- #
+# hedged retries                                                         #
+# --------------------------------------------------------------------- #
+
+def test_hedge_first_response_wins_duplicate_discarded(stub_router,
+                                                       monkeypatch):
+    slow = StubReplica("r0", delay=1.5)
+    fast = StubReplica("r1", queue_depth=1)   # r0 places first
+    r = stub_router(slow, fast)
+    monkeypatch.setenv("ABPOA_TPU_FLEET_HEDGE_S", "0.1")
+    out = r.route(b">s\nACGT\n", {}, "rid-hedge")
+    assert out.code == 200 and out.replica == "r1"
+    assert out.hedges == 1 and out.hedge_won and out.attempt == 2
+    # the slow primary still completes in its daemon thread and is
+    # discarded idempotently — one delivery per replica, no crash
+    deadline = time.time() + 5
+    while len(slow.seen) < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert slow.seen == [("rid-hedge", 1)]
+    assert fast.seen == [("rid-hedge", 2)]
+
+
+def test_hedge_delay_derives_from_sketch_and_env(monkeypatch):
+    from abpoa_tpu.obs.metrics import LogSketch
+    from abpoa_tpu.serve.router import hedge_delay_s
+    sk = LogSketch()
+    monkeypatch.delenv("ABPOA_TPU_FLEET_HEDGE_S", raising=False)
+    assert hedge_delay_s(sk) is None          # cold sketch: no hedging
+    for _ in range(50):
+        sk.observe(0.2)
+    d = hedge_delay_s(sk)
+    assert d is not None and 0.3 < d < 0.5    # ~2x p95 within tolerance
+    monkeypatch.setenv("ABPOA_TPU_FLEET_HEDGE_S", "off")
+    assert hedge_delay_s(sk) is None
+    monkeypatch.setenv("ABPOA_TPU_FLEET_HEDGE_S", "1.25")
+    assert hedge_delay_s(sk) == 1.25
+
+
+# --------------------------------------------------------------------- #
+# connection semantics through the proxy hop (satellite 4)               #
+# --------------------------------------------------------------------- #
+
+def _raw_post(host, port, body=b">s\nACGT\n", cl=None):
+    """One POST over a raw http.client connection; returns (status,
+    headers, connection) with the response fully read — the caller can
+    then PROVE keep-alive by reusing the same connection."""
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    headers = {"Content-Type": "text/x-fasta"}
+    if cl is not None:
+        headers["Content-Length"] = cl
+    conn.request("POST", "/align", body=body, headers=headers)
+    resp = conn.getresponse()
+    resp.read()
+    return resp.status, dict(resp.getheaders()), conn
+
+
+def _assert_conn_closed(conn):
+    """The server must have CLOSED the keep-alive socket (the
+    single-process semantics for every body-unread disposition): a
+    second request on the same connection cannot complete."""
+    with pytest.raises((http.client.HTTPException, ConnectionError,
+                        OSError)):
+        conn.request("POST", "/align", body=b">s\nACGT\n",
+                     headers={"Content-Type": "text/x-fasta"})
+        conn.getresponse().read()
+    conn.close()
+
+
+def test_router_draining_503_closes_with_retry_after(stub_router):
+    s0 = StubReplica("r0")
+    r = stub_router(s0, start_http=True)
+    r.begin_drain()
+    status, headers, conn = _raw_post("127.0.0.1", r.port)
+    assert status == 503
+    assert headers.get("Retry-After") == "30"       # serve's exact value
+    _assert_conn_closed(conn)
+
+
+def test_router_oversized_413_closes(stub_router, monkeypatch):
+    monkeypatch.setenv("ABPOA_TPU_SERVE_MAX_BODY_MB", "0.00001")  # 10 B
+    s0 = StubReplica("r0")
+    r = stub_router(s0, start_http=True)
+    status, headers, conn = _raw_post("127.0.0.1", r.port,
+                                      body=b">s\n" + b"A" * 64 + b"\n")
+    assert status == 413
+    assert s0.seen == []          # never proxied
+    _assert_conn_closed(conn)
+
+
+def test_router_malformed_content_length_400_closes(stub_router):
+    s0 = StubReplica("r0")
+    r = stub_router(s0, start_http=True)
+    with socket.create_connection(("127.0.0.1", r.port),
+                                  timeout=10) as sk:
+        sk.sendall(b"POST /align HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: zzz\r\n\r\n")
+        data = sk.recv(4096)
+        assert b" 400 " in data.split(b"\r\n", 1)[0]
+        # the server actually closes: EOF, not a hung keep-alive
+        sk.settimeout(5)
+        rest = b"x"
+        while rest:
+            rest = sk.recv(4096)
+    assert s0.seen == []
+
+
+def test_proxied_shed_keeps_connection_alive_no_desync(stub_router):
+    """Regression: a proxied 429 must NOT close (the router read the
+    client body), and the SAME client connection must cleanly carry the
+    next request — no keep-alive desync through the proxy hop."""
+    s0 = StubReplica("r0", mode="shed", retry_after="7")
+    r = stub_router(s0, start_http=True)
+    status, headers, conn = _raw_post("127.0.0.1", r.port)
+    assert status == 429
+    assert headers.get("Retry-After") == "7"        # propagated verbatim
+    assert headers.get("Connection") != "close"
+    # second request on the same socket: proves framing stayed aligned
+    s0.mode = "ok"
+    conn.request("POST", "/align", body=b">s\nACGT\n",
+                 headers={"Content-Type": "text/x-fasta"})
+    resp = conn.getresponse()
+    body = resp.read()
+    assert resp.status == 200 and b"served_by" in body
+    assert resp.getheader("X-Abpoa-Replica") == "r0"
+    assert resp.getheader("X-Abpoa-Attempt") == "1"
+    conn.close()
+
+
+def test_router_metrics_endpoint_merges_replica_expositions(stub_router):
+    s0, s1 = StubReplica("r0"), StubReplica("r1")
+    r = stub_router(s0, s1, start_http=True)
+    r.route(b">s\nACGT\n", {}, "rid-m")   # one routed request on record
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{r.port}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    from abpoa_tpu.obs import metrics as M
+    assert M.lint_exposition(text) == []
+    samples, _types = M.parse_exposition(text)
+    # replica families sum across the fleet; router families ride along
+    assert M.sample_value(samples, "stub_requests_total") >= 1
+    assert M.sample_value(samples, "abpoa_fleet_requests_total",
+                          status="ok") >= 1
+
+
+# --------------------------------------------------------------------- #
+# supervisor over fake replicas                                          #
+# --------------------------------------------------------------------- #
+
+FAKE_REPLICA = r'''
+import json, os, signal, sys, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+name = os.environ.get("ABPOA_TPU_REPLICA", "?")
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _j(self, code, obj):
+        b = (json.dumps(obj) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(b)))
+        self.end_headers()
+        self.wfile.write(b)
+
+    def do_GET(self):
+        if self.path == "/readyz":
+            self._j(200, {"status": "ready"})
+        elif self.path == "/healthz":
+            self._j(200, {"status": "ok", "queue_depth": 0, "inflight": 0,
+                          "pid": os.getpid()})
+        elif self.path == "/metrics":
+            b = ("# HELP fake_up replica liveness\n"
+                 "# TYPE fake_up gauge\nfake_up 1\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+        else:
+            self._j(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        self._j(200, {"pid": os.getpid(), "replica": name})
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+srv.daemon_threads = True
+stop = threading.Event()
+for s in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+    signal.signal(s, lambda *a: stop.set())
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+print(f"[fake {name}] listening on "
+      f"http://127.0.0.1:{srv.server_address[1]}",
+      file=sys.stderr, flush=True)
+stop.wait()
+srv.shutdown()
+sys.exit(0)
+'''
+
+
+@pytest.fixture
+def fake_fleet(tmp_path, monkeypatch):
+    monkeypatch.setenv("ABPOA_TPU_FLEET_POLL_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_POOL_BACKOFF_S", "0.1")
+    monkeypatch.setenv("ABPOA_TPU_FLEET_STALL_S", "0")
+    script = tmp_path / "fake_replica.py"
+    script.write_text(FAKE_REPLICA)
+    sups = []
+
+    def make(n):
+        from abpoa_tpu.serve.fleet import FleetSupervisor
+        sup = FleetSupervisor(
+            n, archive_base=str(tmp_path / "reports"),
+            replica_cmd=lambda i, name, argv: [sys.executable,
+                                               str(script)])
+        sup.start()
+        runner = threading.Thread(target=sup.run_forever,
+                                  kwargs={"tick_s": 0.05}, daemon=True)
+        runner.start()
+        deadline = time.time() + 30
+        while sup.router.ready_count() < n and time.time() < deadline:
+            time.sleep(0.05)
+        assert sup.router.ready_count() == n, "fleet never became ready"
+        sups.append(sup)
+        return sup
+
+    yield make
+    for sup in sups:
+        sup.shutdown()
+
+
+def test_supervisor_respawns_sigkilled_replica(fake_fleet):
+    sup = fake_fleet(2)
+    victim = sup.replicas[0]
+    old_pid = victim.proc.pid
+    os.kill(old_pid, signal.SIGKILL)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if (sup.router.ready_count() == 2 and victim.proc is not None
+                and victim.proc.pid != old_pid):
+            break
+        time.sleep(0.05)
+    assert victim.proc is not None and victim.proc.pid != old_pid
+    assert sup.router.ready_count() == 2
+    assert victim.respawns >= 1
+
+
+def test_rolling_restart_never_below_n_minus_1_ready(fake_fleet):
+    sup = fake_fleet(3)
+    before = [r.proc.pid for r in sup.replicas]
+    samples = []
+    done = threading.Event()
+
+    def sample():
+        while not done.is_set():
+            samples.append(sup.router.ready_count())
+            time.sleep(0.02)
+
+    t = threading.Thread(target=sample, daemon=True)
+    t.start()
+    sup.rolling_restart(ready_timeout=30)
+    done.set()
+    t.join(5)
+    after = [r.proc.pid for r in sup.replicas]
+    assert all(a != b for a, b in zip(after, before)), \
+        "every replica must have been restarted"
+    assert samples and min(samples) >= 2, \
+        f"ready capacity dipped below N-1: min={min(samples)}"
+    assert sup.router.ready_count() == 3
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: the real fleet, a real SIGKILL, the real archive           #
+# --------------------------------------------------------------------- #
+
+def _oracle_bytes(path=TEST_FA):
+    import io
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa
+    abpt = Params()
+    abpt.device = "numpy"
+    buf = io.StringIO()
+    msa(Abpoa(), abpt.finalize(), read_fastx(path), buf)
+    return buf.getvalue().encode()
+
+
+def _post(base, body, timeout=60):
+    req = urllib.request.Request(base + "/align", data=body,
+                                 method="POST",
+                                 headers={"Content-Type": "text/x-fasta"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_fleet_e2e_sigkill_failover_why_and_drain(tmp_path):
+    """The chaos acceptance path in miniature: 2 numpy replicas, SIGKILL
+    one with requests in flight -> every response is still a 200
+    byte-identical to the oracle (the killed replica's via attempt 2 on
+    the sibling), `why` names the hop across replica archives, SIGTERM
+    drains the whole fleet rc=0."""
+    archive_base = str(tmp_path / "reports")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               ABPOA_TPU_SKIP_PROBE="1",
+               ABPOA_TPU_ARCHIVE="1",
+               ABPOA_TPU_ARCHIVE_DIR=archive_base,
+               ABPOA_TPU_SERVE_DELAY_S="1.0",
+               ABPOA_TPU_FLEET_POLL_S="0.1",
+               ABPOA_TPU_FLEET_HEDGE_S="off")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--replicas", "2",
+         "--port", "0", "--device", "numpy", "--workers", "2",
+         "--warm", "off"],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    stderr_tail = []
+    try:
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            if not line:
+                assert proc.poll() is None, "fleet died during startup"
+                continue
+            stderr_tail.append(line)
+            if "[abpoa-tpu fleet] listening on http://" in line:
+                port = int(line.split("listening on http://")[1]
+                           .split()[0].rsplit(":", 1)[1])
+                break
+        assert port, "fleet never printed its listening line"
+        threading.Thread(
+            target=lambda: stderr_tail.extend(proc.stderr),
+            daemon=True).start()
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 120
+        ready = False
+        while time.time() < deadline and not ready:
+            try:
+                with urllib.request.urlopen(base + "/readyz",
+                                            timeout=2) as r:
+                    ready = r.status == 200
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        assert ready, "fleet router never became ready"
+        # wait for BOTH replicas so the kill leaves a sibling
+        deadline = time.time() + 60
+        pids = {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                doc = json.loads(r.read())
+            if doc.get("ready") == 2:
+                pids = doc["fleet"]["pids"]
+                break
+            time.sleep(0.2)
+        assert len(pids) == 2, f"fleet never reached 2 ready: {doc}"
+
+        body = open(TEST_FA, "rb").read()
+        results = {}
+
+        def post(i):
+            results[i] = _post(base, body, timeout=90)
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        time.sleep(0.4)        # all four in flight (1 s service time)
+        os.kill(pids["r0"], signal.SIGKILL)
+        for t in threads:
+            t.join(120)
+        oracle = _oracle_bytes()
+        assert all(code == 200 for code, _b, _h in results.values()), \
+            {i: r[0] for i, r in results.items()}
+        assert all(b == oracle for _c, b, _h in results.values())
+        hopped = [h for _c, _b, h in results.values()
+                  if int(h.get("X-Abpoa-Failovers") or 0) >= 1]
+        assert hopped, "no request recorded a failover hop " \
+                       f"({[r[2] for r in results.values()]})"
+        assert all(int(h.get("X-Abpoa-Attempt") or 1) > 1
+                   for h in hopped)
+        rid = hopped[0]["X-Abpoa-Request-Id"]
+
+        # `why` resolves the id across the replica archives and names
+        # the hop (the killed attempt left no record; the surviving
+        # record's attempt number tells the story)
+        why = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "why", rid,
+             "--fleet", "--archive-dir", archive_base],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert why.returncode == 0, why.stderr
+        assert rid in why.stdout
+        assert "attempt" in why.stdout and "replica" in why.stdout
+
+        # `slo --fleet` evaluates the merged replica window
+        slo = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "slo", "--fleet",
+             "--archive-dir", archive_base],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert slo.returncode == 0, slo.stdout + slo.stderr
+        assert "replica archives" in slo.stdout
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, f"fleet drain rc={rc}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    tail = "".join(stderr_tail)
+    assert "drained clean" in tail
+    assert "Traceback" not in tail
+    # the surviving replica's archive holds the attempt-2 record under
+    # the fleet layout slo/why just read
+    surviving = os.path.join(archive_base, "replica-r1", "reports.jsonl")
+    assert os.path.exists(surviving)

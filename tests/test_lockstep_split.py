@@ -330,6 +330,108 @@ def test_scheduler_metrics_and_top_panel():
     scheduler.reset()
 
 
+# --------------------------------------------------------------------- #
+# vectorized host table build (round 16)                                #
+# --------------------------------------------------------------------- #
+
+def _reference_lockstep_tables(g, abpt, query, Qp):
+    """The pre-round-16 per-row loop build, kept verbatim as the parity
+    reference for the vectorized batch build in dp_chunk."""
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.align.dp_chunk import P_FLOOR
+    from abpoa_tpu.compile.buckets import bucket_pow2 as _bucket_pow2
+    if not g.is_topological_sorted:
+        g.topological_sort(abpt)
+    n = g.node_n
+    qlen = len(query)
+    nodes = g.nodes
+    idx2nid = g.index_to_node_id
+    n2i = g.node_id_to_index
+    remain = g.node_id_to_max_remain
+    pre_lists, out_lists, d_max = [], [], 1
+    for i in range(n):
+        nd = nodes[int(idx2nid[i])]
+        pl = [int(n2i[p]) for p in nd.in_ids] if 0 < i < n else []
+        ol = [int(n2i[o]) for o in nd.out_ids] if 0 < i < n - 1 else []
+        pre_lists.append(pl)
+        out_lists.append(ol)
+        d_max = max(d_max, len(pl), len(ol))
+    P = max(P_FLOOR, _bucket_pow2(d_max))
+    base_r = np.zeros(n, np.int32)
+    pre_idx = np.zeros((n, P), np.int32)
+    pre_msk = np.zeros((n, P), bool)
+    out_idx = np.zeros((n, P), np.int32)
+    out_msk = np.zeros((n, P), bool)
+    row_active = np.zeros(n, bool)
+    remain_rows = np.zeros(n, np.int32)
+    for i in range(n):
+        nd = nodes[int(idx2nid[i])]
+        base_r[i] = nd.base
+        remain_rows[i] = remain[int(idx2nid[i])]
+        pl = pre_lists[i]
+        pre_idx[i, :len(pl)] = pl
+        pre_msk[i, :len(pl)] = True
+        ol = out_lists[i]
+        out_idx[i, :len(ol)] = ol
+        out_msk[i, :len(ol)] = True
+        row_active[i] = 0 < i < n - 1
+    mpl0 = np.full(n, n, np.int32)
+    mpl0[0] = 0
+    mpr0 = np.zeros(n, np.int32)
+    src_rows = [int(n2i[o]) for o in nodes[C.SRC_NODE_ID].out_ids]
+    mpl0[src_rows] = 1
+    mpr0[src_rows] = 1
+    w = abpt.wb + int(abpt.wf * qlen)
+    remain_end = int(remain[C.SINK_NODE_ID])
+    if abpt.align_mode == C.LOCAL_MODE:
+        dp_end0 = qlen
+    else:
+        r0 = qlen - (int(remain_rows[0]) - remain_end - 1)
+        dp_end0 = min(qlen, max(int(mpr0[0]), r0) + w)
+    qp = np.zeros((abpt.m, Qp), np.int32)
+    query_pad = np.zeros(Qp, np.int32)
+    if qlen:
+        qp[:, 1: qlen + 1] = abpt.mat[:, query]
+        query_pad[:qlen] = query
+    return dict(base_r=base_r, pre_idx=pre_idx, pre_msk=pre_msk,
+                out_idx=out_idx, out_msk=out_msk, row_active=row_active,
+                remain_rows=remain_rows, mpl0=mpl0, mpr0=mpr0, qp=qp,
+                query=query_pad, n_rows=n, qlen=qlen, w=w,
+                remain_end=remain_end, dp_end0=dp_end0)
+
+
+def test_build_lockstep_tables_vectorized_parity():
+    """The round-16 numpy batch build of the per-round host tables is
+    field-for-field identical to the per-row loop it replaced, on real
+    POA graphs at every incremental read count (branchy mid-progress
+    graphs, not just the final one)."""
+    from abpoa_tpu.align.dp_chunk import build_lockstep_tables
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records, poa
+    for fn in ("test.fa", "heter.fa"):
+        abpt = _params(device="numpy")
+        seqs, weights = _ingest_records(
+            Abpoa(), abpt, read_fastx(os.path.join(DATA_DIR, fn)))
+        for j in range(1, len(seqs)):
+            ab = Abpoa()
+            for r in seqs[:j]:
+                ab.append_read(seq="x" * len(r))
+            poa(ab, abpt, seqs[:j], weights[:j], 0)
+            q = seqs[j]
+            Qp = len(q) + 9
+            got = build_lockstep_tables(ab.graph, abpt, q, Qp)
+            want = _reference_lockstep_tables(ab.graph, abpt, q, Qp)
+            assert set(got) == set(want)
+            for key in want:
+                g_v, w_v = got[key], want[key]
+                if isinstance(w_v, np.ndarray):
+                    assert g_v.shape == w_v.shape, key
+                    assert g_v.dtype == w_v.dtype, key
+                    assert np.array_equal(g_v, w_v), (fn, j, key)
+                else:
+                    assert g_v == w_v, (fn, j, key)
+
+
 def test_run_dp_chunk_warmable():
     """The new ladder entry warms: the quick-tier anchor precompiles the
     (R, K) grid the CI micro-run hits, through the same dispatch helper

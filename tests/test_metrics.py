@@ -103,6 +103,57 @@ def test_sketch_merge_and_bounds():
     assert s.quantile(1.0) >= LogSketch.HI / LogSketch.GROWTH
 
 
+def test_merge_expositions_quantiles_match_pooled_sketch():
+    """The fleet rollup contract (round 16): render N per-replica
+    expositions, merge the TEXTS, and the merged histogram's quantiles
+    must match the pooled-observation sketch within the declared
+    LogSketch tolerance — the exposition round-trip loses nothing the
+    tolerance doesn't already allow. Counters/gauges sum; quantile
+    gauges are recomputed, not summed."""
+    from abpoa_tpu.obs import metrics as M
+    rng = random.Random(16)
+    pooled = M.LogSketch()
+    texts = []
+    for rep in range(3):
+        reg = M.MetricsRegistry()
+        h = reg.histogram("abpoa_serve_request_seconds", "latency")
+        # replicas see different latency regimes (the realistic case:
+        # one slow replica skews the fleet tail)
+        lo, hi = (1e-3, 1e-1) if rep < 2 else (5e-2, 2.0)
+        for _ in range(4000):
+            v = rng.uniform(lo, hi)
+            h.observe(v)
+            pooled.observe(v)
+        reg.counter("abpoa_serve_requests_total", "req").inc(
+            100 + rep, status="ok")
+        reg.gauge("abpoa_serve_queue_depth", "depth").set(rep + 1)
+        texts.append(reg.render())
+    merged = M.merge_expositions(texts)
+    assert not M.lint_exposition(merged), M.lint_exposition(merged)
+    samples, types = M.parse_exposition(merged)
+    assert types["abpoa_serve_request_seconds"] == "histogram"
+    # counters and gauges summed per label set
+    assert M.sample_value(samples, "abpoa_serve_requests_total",
+                          status="ok") == 303
+    assert M.sample_value(samples, "abpoa_serve_queue_depth") == 6
+    # merged quantiles vs the pooled sketch, within declared tolerance
+    sk = M.sketch_from_exposition(samples, "abpoa_serve_request_seconds")
+    assert sk.count == pooled.count == 12000
+    assert sk.sum == pytest.approx(pooled.sum, rel=1e-9)
+    for q in (0.5, 0.95, 0.99):
+        assert sk.quantile(q) == pytest.approx(
+            pooled.quantile(q), rel=M.LogSketch.RELATIVE_ERROR)
+        # the recomputed quantile gauge agrees with the merged sketch
+        gq = M.sample_value(samples,
+                            "abpoa_serve_request_seconds_quantile",
+                            quantile=str(q))
+        assert gq == pytest.approx(sk.quantile(q), rel=1e-6)
+    # merging a merged exposition is a no-op (idempotent rollup)
+    again, _ = M.parse_exposition(M.merge_expositions([merged]))
+    sk2 = M.sketch_from_exposition(again, "abpoa_serve_request_seconds")
+    assert sk2.counts == sk.counts and sk2.count == sk.count
+
+
 # --------------------------------------------------------------------- #
 # exporter                                                              #
 # --------------------------------------------------------------------- #

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Standalone launcher for the replica fleet: `abpoa-tpu fleet` without
+an installed package.
+
+    python tools/fleet.py --replicas 3 --device numpy --warm quick
+
+Everything after the script name is the `abpoa-tpu serve` flag set; the
+fleet-level meaning of --host/--port (the ROUTER socket) and --metrics
+(the merged fleet exposition textfile) is documented in
+abpoa_tpu/serve/fleet.py. SIGHUP rolling-restarts the replicas one at a
+time; SIGTERM drains the whole fleet and exits 0.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from abpoa_tpu.serve.fleet import fleet_main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(fleet_main(sys.argv[1:]))

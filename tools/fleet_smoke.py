@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Fleet chaos smoke: the measured form of ISSUE 16's acceptance
+criteria (CI job `fleet-smoke`).
+
+Starts `abpoa-tpu serve --replicas 3` (numpy device — no accelerator,
+instant replica startup; a 50 ms service-time shim makes throughput a
+deliverable number), calibrates the single-replica sustainable rate,
+then soaks the ROUTER at ~2x that rate while SIGKILLing one replica
+mid-soak. The fleet must:
+
+- lose ZERO requests: loadgen reports 0 transport errors and no 5xx —
+  the killed replica's in-flight requests are failed over exactly once
+  to a sibling (same request id, attempt 2) and still answer 200;
+- keep every 200 byte-identical to the numpy oracle, through the kill
+  and the respawn;
+- respawn the killed replica (supervisor backoff) and return to 3 ready;
+- expose ONE merged fleet exposition (router /metrics = replica scrapes
+  + router families via merge_expositions) that lints clean, with the
+  --metrics textfile carrying the same roll-up;
+- answer `abpoa-tpu slo --fleet` rc=0 over the merged replica archives,
+  and `abpoa-tpu why <id>` for a failed-over request id, naming the
+  replica hop;
+- drain clean on SIGTERM: every replica SIGTERMed, router stopped, rc 0.
+
+    python tools/fleet_smoke.py [--requests N] [--keep]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+DATA = os.path.join(REPO, "tests", "data")
+sys.path.insert(0, REPO)
+sys.path.insert(0, TOOLS)
+
+from serve_smoke import (_drain_stderr, oracle_body, read_port,  # noqa: E402
+                         wait_ready)
+
+
+def get_json(base: str, path: str) -> dict:
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=240,
+                    help="soak request count [%(default)s]")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir for inspection")
+    args = ap.parse_args(argv)
+    tmp = tempfile.mkdtemp(prefix="abpoa_fleet_smoke_")
+    failures: list = []
+    payload = os.path.join(DATA, "test.fa")
+    oracles = {oracle_body(payload)}
+    archive_base = os.path.join(tmp, "reports")
+    metrics_path = os.path.join(tmp, "fleet_metrics.prom")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               ABPOA_TPU_SKIP_PROBE="1",
+               ABPOA_TPU_ARCHIVE="1",
+               ABPOA_TPU_ARCHIVE_DIR=archive_base,
+               # the service-time shim: deliverable-throughput floor,
+               # and the window that keeps requests IN FLIGHT when the
+               # SIGKILL lands
+               ABPOA_TPU_SERVE_DELAY_S="0.05",
+               ABPOA_TPU_FLEET_POLL_S="0.1",
+               ABPOA_TPU_POOL_BACKOFF_S="0.2")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--replicas", "3",
+         "--port", "0", "--device", "numpy", "--workers", "2",
+         "--warm", "off", "--metrics", metrics_path],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    stderr_tail: list = []
+    try:
+        # the FIRST listening line is the router's (printed before any
+        # replica spawns); replica lines arrive later under [rN] prefixes
+        port = read_port(proc)
+        base = f"http://127.0.0.1:{port}"
+        threading.Thread(target=_drain_stderr, args=(proc, stderr_tail),
+                         daemon=True).start()
+        wait_ready(base, proc, timeout_s=120)
+
+        # full strength before the chaos: 3 ready replicas, known pids
+        pids = {}
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            doc = get_json(base, "/healthz")
+            if doc.get("ready") == 3:
+                pids = doc["fleet"]["pids"]
+                break
+            time.sleep(0.2)
+        if len(pids) != 3:
+            failures.append(f"fleet never reached 3 ready replicas: {doc}")
+            raise RuntimeError("startup failed")
+        print(f"[fleet-smoke] 3 replicas ready, pids={pids}", flush=True)
+
+        from loadgen import LoadGen
+        with open(payload, "rb") as fp:
+            body = fp.read()
+
+        # ---- calibrate the single-replica sustainable rate ----
+        cal = LoadGen(base, [body], rate=5.0, n=12, timeout_s=120,
+                      fleet=True).run()
+        p50_s = max(1e-3, (cal["latency_ms"]["p50"] or 50.0) / 1e3)
+        sustainable = 2 / p50_s            # 2 workers per replica
+        rate = min(max(4.0, 2.0 * sustainable), 150.0)
+        print(f"[fleet-smoke] calibrated p50={p50_s * 1e3:.1f}ms -> "
+              f"single-replica sustainable ~{sustainable:.0f}/s, soaking "
+              f"the 3-replica fleet at {rate:.0f}/s "
+              f"({args.requests} requests)", flush=True)
+
+        # ---- chaos soak: SIGKILL one replica with requests in flight --
+        kill_at = 0.3 * args.requests / rate
+
+        def kill_one():
+            try:
+                os.kill(pids["r0"], signal.SIGKILL)
+                print(f"[fleet-smoke] SIGKILLed replica r0 "
+                      f"(pid {pids['r0']}) mid-soak", flush=True)
+            except OSError as e:
+                failures.append(f"replica kill failed: {e}")
+
+        timer = threading.Timer(kill_at, kill_one)
+        timer.start()
+        gen = LoadGen(base, [body], rate=rate, n=args.requests,
+                      timeout_s=120, fleet=True)
+        soak = gen.run()
+        timer.cancel()
+        print("[fleet-smoke] soak:", json.dumps(soak), flush=True)
+
+        # zero lost requests: no transport errors, no 5xx — the kill is
+        # at most an invisible retried attempt
+        if soak["errors"]:
+            failures.append(f"{soak['errors']} transport errors through "
+                            "the replica kill")
+        bad = {c: n for c, n in soak["status"].items()
+               if c.startswith("5") or c == "0"}
+        if bad:
+            failures.append(f"5xx through the replica kill: {bad}")
+        if soak["fleet"]["failovers"] < 1 \
+                and soak["fleet"]["retried_ok"] < 1:
+            failures.append("no failover recorded — the kill never "
+                            "exercised the retry path "
+                            f"({soak['fleet']})")
+        if len(soak["fleet"]["by_replica"]) < 2:
+            failures.append("soak traffic never spread across replicas: "
+                            f"{soak['fleet']['by_replica']}")
+        bad_bodies = sum(1 for b in gen.bodies_ok if b not in oracles)
+        if bad_bodies:
+            failures.append(f"{bad_bodies}/{len(gen.bodies_ok)} 200 "
+                            "bodies NOT byte-identical to the numpy "
+                            "oracle")
+
+        # ---- the supervisor respawns: back to 3 ready ----
+        deadline = time.time() + 60
+        back = 0
+        while time.time() < deadline:
+            back = get_json(base, "/healthz").get("ready", 0)
+            if back == 3:
+                break
+            time.sleep(0.3)
+        if back != 3:
+            failures.append(f"killed replica never respawned: "
+                            f"{back}/3 ready")
+
+        # ---- merged exposition lints (router endpoint + textfile) ----
+        from abpoa_tpu.obs import metrics as M
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            expo = r.read().decode()
+        lint = M.lint_exposition(expo)
+        if lint:
+            failures.append(f"merged /metrics lint: {lint[:3]}")
+        samples, _types = M.parse_exposition(expo)
+        served = M.sample_value(samples, "abpoa_serve_requests_total",
+                                status="ok")
+        routed = M.sample_value(samples, "abpoa_fleet_requests_total",
+                                status="ok")
+        if not served or not routed:
+            failures.append("merged exposition is missing replica or "
+                            f"router families (served={served}, "
+                            f"routed={routed})")
+        time.sleep(2.5)               # one textfile roll interval
+        try:
+            with open(metrics_path) as fp:
+                tf = fp.read()
+            if M.lint_exposition(tf):
+                failures.append("metrics textfile roll-up does not lint")
+        except OSError as e:
+            failures.append(f"metrics textfile missing: {e}")
+
+        # ---- slo --fleet over the merged replica archives ----
+        slo = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "slo", "--fleet"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        print("[fleet-smoke] slo --fleet:\n" + slo.stdout, flush=True)
+        if slo.returncode != 0:
+            failures.append(f"`slo --fleet` rc={slo.returncode}:\n"
+                            + slo.stdout + slo.stderr)
+
+        # ---- `why` explains a failed-over request across archives ----
+        from abpoa_tpu.obs import archive as A
+        hop = next((rec for rec in A.read_fleet_window(0, archive_base)
+                    if (rec.get("attempt") or 1) > 1
+                    and rec.get("request_id")), None)
+        if hop is None:
+            failures.append("no attempt>1 record in any replica archive "
+                            "— the failover hop left no trace")
+        else:
+            why = subprocess.run(
+                [sys.executable, "-m", "abpoa_tpu.cli", "why",
+                 hop["request_id"], "--fleet"],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=120)
+            print(f"[fleet-smoke] why {hop['request_id']}:\n"
+                  + why.stdout, flush=True)
+            if why.returncode != 0:
+                failures.append(f"`why --fleet` rc={why.returncode}: "
+                                + why.stderr[-500:])
+            elif "attempt" not in why.stdout \
+                    or "replica" not in why.stdout:
+                failures.append("why output does not name the replica "
+                                "hop:\n" + why.stdout)
+
+        # ---- fleet drain: SIGTERM -> rc 0 ----
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 0:
+            failures.append(f"SIGTERM fleet drain exited rc={rc}")
+        tail = "".join(stderr_tail)
+        if "drained clean" not in tail:
+            failures.append("fleet never printed its drain summary")
+        if "Traceback" in tail:
+            failures.append("fleet stderr carries a Traceback:\n"
+                            + tail[-2000:])
+    except RuntimeError:
+        pass
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if args.keep:
+            print(f"[fleet-smoke] kept workdir: {tmp}", flush=True)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        print("\n[fleet-smoke] FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("[fleet-smoke] OK: 3-replica fleet survived a mid-soak "
+          "SIGKILL with zero lost requests, byte-identical 200s, a "
+          "merged lint-clean exposition, slo --fleet rc=0 and a "
+          "narrated failover hop", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,7 +53,8 @@ class LoadGen:
 
     def __init__(self, url: str, payloads: List[bytes], rate: float,
                  n: int, timeout_s: float = 60.0, max_inflight: int = 256,
-                 deadline_hdr: Optional[float] = None) -> None:
+                 deadline_hdr: Optional[float] = None,
+                 fleet: bool = False) -> None:
         self.url = url.rstrip("/")
         self.payloads = payloads
         self.rate = rate
@@ -61,6 +62,7 @@ class LoadGen:
         self.timeout_s = timeout_s
         self.max_inflight = max_inflight
         self.deadline_hdr = deadline_hdr
+        self.fleet = fleet
         self.sketch = LogSketch()
         self.status: dict = {}
         self.errors = 0
@@ -70,6 +72,13 @@ class LoadGen:
         # the ids make soak latency outliers directly greppable into
         # their traces/dumps (`abpoa-tpu why <id>`)
         self.requests: List[tuple] = []
+        # --fleet attribution from the router's response headers:
+        # which replica answered (X-Abpoa-Replica), and how many answers
+        # needed a failover hop or a hedge (X-Abpoa-Failovers/-Hedges)
+        self.by_replica: dict = {}
+        self.failovers = 0
+        self.hedges = 0
+        self.retried_ok = 0   # 200s whose winning attempt was > 1
         self._lock = threading.Lock()
         self._inflight = 0
 
@@ -81,14 +90,16 @@ class LoadGen:
         req = urllib.request.Request(self.url + "/align", data=payload,
                                      method="POST", headers=headers)
         t0 = time.perf_counter()
-        code, body, rid = 0, b"", None
+        code, body, rid, hdrs = 0, b"", None, None
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 code, body = r.status, r.read()
                 rid = r.headers.get("X-Abpoa-Request-Id")
+                hdrs = r.headers
         except urllib.error.HTTPError as e:
             code = e.code
             rid = e.headers.get("X-Abpoa-Request-Id")
+            hdrs = e.headers
             e.read()
         except (urllib.error.URLError, OSError, TimeoutError):
             code = 0  # transport error / client timeout
@@ -101,6 +112,15 @@ class LoadGen:
                 self.errors += 1
             elif code == 200:
                 self.bodies_ok.append(body)
+            if self.fleet and hdrs is not None:
+                rep = hdrs.get("X-Abpoa-Replica")
+                if rep:
+                    by = self.by_replica.setdefault(rep, {})
+                    by[str(code)] = by.get(str(code), 0) + 1
+                self.failovers += int(hdrs.get("X-Abpoa-Failovers") or 0)
+                self.hedges += int(hdrs.get("X-Abpoa-Hedges") or 0)
+                if code == 200 and int(hdrs.get("X-Abpoa-Attempt") or 1) > 1:
+                    self.retried_ok += 1
             self._inflight -= 1
 
     def run(self) -> dict:
@@ -132,7 +152,7 @@ class LoadGen:
             return round(1e3 * v, 2) if v is not None else None
 
         launched = self.n - self.client_dropped
-        return {
+        out = {
             "url": self.url,
             "sent": launched,
             "client_dropped": self.client_dropped,
@@ -155,16 +175,31 @@ class LoadGen:
                         for dt, code, rid in sorted(
                             self.requests, key=lambda t: -t[0])[:5]],
         }
+        if self.fleet:
+            # who actually served the traffic, and how often the router
+            # had to hop (failover) or race (hedge) to keep the 200s
+            # flowing — the chaos soak's "zero failed requests" evidence
+            out["fleet"] = {
+                "by_replica": {k: dict(sorted(v.items()))
+                               for k, v in sorted(self.by_replica.items())},
+                "failovers": self.failovers,
+                "hedges": self.hedges,
+                "retried_ok": self.retried_ok,
+            }
+        return out
 
 
 def run_sweep(url: str, payloads: List[bytes], rates: List[float],
-              n_per_rate: int, timeout_s: float = 60.0) -> List[dict]:
+              n_per_rate: int, timeout_s: float = 60.0,
+              fleet: bool = False) -> List[dict]:
     """The overload-rejection curve: one open-loop run per arrival rate,
-    ascending — PERF.md's served-throughput figure."""
+    ascending — PERF.md's served-throughput figure. With `fleet`, each
+    pass also attributes responses per replica and counts the router's
+    failover/hedge hops at that rate."""
     out = []
     for rate in rates:
         out.append(LoadGen(url, payloads, rate, n_per_rate,
-                           timeout_s=timeout_s).run())
+                           timeout_s=timeout_s, fleet=fleet).run())
     return out
 
 
@@ -189,6 +224,11 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", type=str, default=None, metavar="R1,R2,...",
                     help="run the overload curve: one pass per rate, "
                          "--n requests each; output is a JSON list")
+    ap.add_argument("--fleet", action="store_true",
+                    help="target is an `abpoa-tpu fleet` router: "
+                         "attribute every response to its replica "
+                         "(X-Abpoa-Replica) and report the router's "
+                         "failover/hedge counts in the summary")
     ap.add_argument("--out", type=str, default=None, metavar="FILE",
                     help="write the JSON summary to FILE (stdout always "
                          "gets it too)")
@@ -200,13 +240,14 @@ def main(argv=None) -> int:
     if args.sweep:
         rates = [float(r) for r in args.sweep.split(",")]
         result = run_sweep(args.url, payloads, rates, args.n,
-                           timeout_s=args.timeout_s)
+                           timeout_s=args.timeout_s, fleet=args.fleet)
         worst = max((r["errors"] for r in result), default=0)
     else:
         result = LoadGen(args.url, payloads, args.rate, args.n,
                          timeout_s=args.timeout_s,
                          max_inflight=args.max_inflight,
-                         deadline_hdr=args.deadline_s).run()
+                         deadline_hdr=args.deadline_s,
+                         fleet=args.fleet).run()
         worst = result["errors"]
     text = json.dumps(result, indent=1)
     print(text)
