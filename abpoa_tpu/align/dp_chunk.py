@@ -145,6 +145,14 @@ def build_lockstep_tables(g, abpt: Params, query: np.ndarray,
     driver rebuilds these tables for every set of every round, so this is
     the per-round host cost every many-core/fleet deployment pays.
     """
+    if len(query) + 2 > Qp:
+        # the lane-churn rung contract: every read of every lane —
+        # initial or mid-flight joiner — must fit the group's planned Qp
+        # (qp_rung guarantees qmax + 2 <= Qp; the split driver rejects
+        # off-rung joiners before they reach a table build)
+        raise ValueError(
+            f"query len {len(query)} does not fit Qp {Qp} (needs qlen + 2 "
+            "<= Qp): an off-rung lane slipped past the driver's join gate")
     if not g.is_topological_sorted:
         g.topological_sort(abpt)
     n = g.node_n

@@ -436,6 +436,16 @@ _EXACT_FAMILIES = {
                                    "Split-lockstep sets sent to the "
                                    "sequential path by a device backtrack "
                                    "divergence"),
+    "lockstep.joins": ("abpoa_lockstep_joins_total",
+                       "Requests that joined an in-flight lockstep group "
+                       "at a round boundary (continuous batching)"),
+    "lockstep.early_retires": ("abpoa_lockstep_early_retires_total",
+                               "Lanes retired from an in-flight lockstep "
+                               "group before the group ended (result "
+                               "returned early, slot freed for joiners)"),
+    "lockstep.evictions": ("abpoa_lockstep_evictions_total",
+                           "Lanes evicted from an in-flight lockstep group "
+                           "at a round boundary (deadline expired)"),
     "dp.dispatches": ("abpoa_dp_dispatches_total", "DP kernel dispatches"),
     "dp.cells": ("abpoa_dp_cells_total", "DP cells computed"),
     "dp.cell_ops": ("abpoa_dp_cell_ops_total",
@@ -581,6 +591,28 @@ def publish_noop_fraction(ewma: float) -> None:
             "abpoa_lockstep_noop_fraction",
             "EWMA of the lockstep idle-lane fraction (divergence; feeds "
             "the scheduler's sub-batch K cap)").set(ewma)
+
+
+def publish_lane_occupancy(ewma: float) -> None:
+    """Measured lockstep lane occupancy EWMA (live lanes / group capacity,
+    fed per round by the split driver's lane table). Under churn this stays
+    near 1.0 — the continuous-batching gate compares it against the static
+    baseline's (1 - noop EWMA)."""
+    if _ENABLED:
+        _REGISTRY.gauge(
+            "abpoa_lockstep_lane_occupancy",
+            "EWMA of measured lockstep lane occupancy (live lanes over "
+            "group capacity, per round)").set(ewma)
+
+
+def publish_join_wait(wait_s: float) -> None:
+    """Queue-to-board latency of one continuous-batching join: arrival to
+    the round boundary that admitted it into the in-flight group."""
+    if _ENABLED:
+        _REGISTRY.histogram(
+            "abpoa_lockstep_join_wait_seconds",
+            "Wait from request arrival to joining an in-flight lockstep "
+            "group (continuous batching)").observe(wait_s)
 
 
 def publish_route(route) -> None:

@@ -131,6 +131,24 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
         if chunks:
             lines.append(f"         lockstep rounds {chunks:.0f}  "
                          f"drain {drains:.0f}")
+        # continuous batching: measured lane occupancy + churn counters
+        # (joins boarded mid-flight, lanes retired early, boundary
+        # evictions) and the join-wait quantiles
+        occ = M.sample_value(samples, "abpoa_lockstep_lane_occupancy")
+        if occ is not None:
+            lines.append(f"         occupancy {occ:.2f} [{_bar(occ, 8)}]")
+        joins = _total(samples, "abpoa_lockstep_joins_total")
+        retires = _total(samples, "abpoa_lockstep_early_retires_total")
+        evicts = _total(samples, "abpoa_lockstep_evictions_total")
+        if joins or retires or evicts:
+            lines.append(f"         churn joins {joins:.0f}  "
+                         f"early-retires {retires:.0f}  "
+                         f"evictions {evicts:.0f}")
+        jq = _labeled(samples, "abpoa_lockstep_join_wait_seconds_quantile",
+                      "quantile")
+        if jq:
+            lines.append("         join wait p50 %.0f ms  p99 %.0f ms"
+                         % (1e3 * jq.get("0.5", 0), 1e3 * jq.get("0.99", 0)))
 
     # process-pool panel (present only when a supervised worker pool ran:
     # -l --workers N or serve --pool-workers N)

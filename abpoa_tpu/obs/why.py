@@ -192,6 +192,19 @@ def verdict(record: Optional[dict], trace_doc: Optional[dict],
     clauses: List[str] = []
     att = _attribution(_trace_spans(trace_doc)) if trace_doc else {}
     wait = att.get("admission_wait") or att.get("pool_wait")
+    # continuous batching (PR 17): a churned request's pickup-time
+    # coalesced_k is stale — the record (and its admission_wait span args)
+    # carry the group id and the round it actually boarded
+    jr = (record or {}).get("join_round")
+    jg = (record or {}).get("join_group")
+    if jr is None and trace_doc:
+        for e in _trace_spans(trace_doc):
+            if e["name"] == "admission_wait":
+                a = e.get("args") or {}
+                if a.get("join_round") is not None:
+                    jr, jg = a.get("join_round"), a.get("join_group")
+    joined = (f"joined group {jg} at round {jr}"
+              if jr is not None else "")
     if status == "timeout":
         head = "504"
         if wait and wall:
@@ -199,18 +212,21 @@ def verdict(record: Optional[dict], trace_doc: Optional[dict],
             for e in _trace_spans(trace_doc):
                 if e["name"] == "admission_wait":
                     k = (e.get("args") or {}).get("coalesced_k")
-            behind = (f" behind a coalesced K={k} group"
+            behind = (f" ({joined})" if joined
+                      else f" behind a coalesced K={k} group"
                       if k and k > 1 else "")
             budget = f" of {deadline:g} s budget" if deadline else ""
             clauses.append(f"{wait:.1f} s{budget} spent in admission wait"
                            f"{behind}")
         elif wall is not None:
-            clauses.append(f"deadline expired after {_fmt_s(wall)}")
+            clauses.append(f"deadline expired after {_fmt_s(wall)}"
+                           + (f" ({joined})" if joined else ""))
     elif status == "ok":
         head = "ok"
         clauses.append(f"served in {_fmt_s(wall)}"
                        + (f" ({_fmt_s(wait)} of it queued)"
-                          if wait and wall and wait > 0.5 * wall else ""))
+                          if wait and wall and wait > 0.5 * wall else "")
+                       + (f" ({joined})" if joined else ""))
     elif status == "poisoned" or status == "quarantined":
         head = "400"
         clauses.append("poisoned set rejected at the quarantine boundary")

@@ -1,6 +1,6 @@
 from .pool import (PoolJob, PoolWorkerError, WorkerPool, resolve_workers,
                    run_hybrid_batch, run_pool_batch)
-from .runner import (flush_lockstep_group, lockstep_enabled,
-                     lockstep_group_size, run_batch, run_lockstep_files,
-                     shard_dp_batch)
+from .runner import (flush_lockstep_group, flush_lockstep_group_churn,
+                     lockstep_enabled, lockstep_group_size, run_batch,
+                     run_lockstep_files, shard_dp_batch)
 from .scheduler import Route, plan_route

@@ -8,19 +8,30 @@ vmapped while_loop's full-plane selects on every read — measured 1.37x
 SLOWER than serial at K=4 on CPU hosts. Here each set's graph lives on the
 HOST (the reference add_alignment fusion, byte-golden engine), and only the
 banded DP scan + backtrack carry the K axis (align/dp_chunk.run_dp_chunk).
-Divergence between sets is visible, not hidden: finished sets free their
-lane at pow2 repack boundaries and `lockstep.noop_set_fraction` records the
-idle-lane fraction each round — the scheduler's K-cap feedback signal.
+
+Continuous batching (PR 17): because fusion is a host-side step between
+rounds, the lane population can legally change at every round boundary.
+The driver keeps a LANE TABLE instead of fixed parallel arrays: a finished
+or backtrack-diverged lane RETIRES immediately (its result goes to its
+future via the churn hook instead of padding the group as a born-finished
+no-op), and same-Qp-rung JOINERS board freed lanes mid-flight. Repacking
+rides the existing pow2 K rungs (`Kb = k_rung(len(dp_ks))` is recomputed
+per round anyway), so churn creates no new compile rungs. Per-round lane
+occupancy (live lanes / group capacity) feeds
+`scheduler.observe_lane_occupancy` — the measured replacement for the
+reactive noop EWMA.
 
 Byte parity: per read this is exactly pipeline.poa's sequence (DP at the
 pre-fusion graph, optional ambiguous-strand RC retry with the host float
 threshold, host add_alignment fusion), so outputs are byte-identical to
-the sequential host loop for any K and any set mix.
+the sequential host loop for any K, any set mix, and any join/retire
+schedule — a lane's reads never see the other lanes' graphs.
 """
 from __future__ import annotations
 
+import os
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -29,16 +40,68 @@ from ..params import Params
 MAX_W_GROWTH = 6
 
 
+class ChurnHook:
+    """Round-boundary lane-churn protocol for progressive_poa_split_batch.
+
+    ``on_round(round_i, live_sids)`` is called before each round (round_i
+    counts from 1) and returns ``(evict_sids, joiners)``: lanes to drop
+    without a result (deadline expired — the hook owns answering them) and
+    new sets to board as ``(sid, seqs, weights)`` tuples. Joiners must be
+    on the group's Qp rung (every read qlen + 2 <= Qp); violators are
+    rejected via ``on_retire(sid, None, round_i)``.
+
+    ``on_retire(sid, result, round_i)`` delivers a lane's result the round
+    it finishes: ``(host_graph, is_rc_flags)``, or ``None`` when the lane
+    must re-run on the caller's sequential path (backtrack divergence).
+    """
+
+    def on_round(self, round_i: int, live_sids: list) -> tuple:
+        return set(), []
+
+    def on_retire(self, sid, result, round_i: int) -> None:  # pragma: no cover
+        pass
+
+
+class _Lane:
+    __slots__ = ("sid", "seqs", "weights", "graph", "is_rc", "cursor",
+                 "n_reads", "join_round")
+
+    def __init__(self, sid, seqs, weights, graph, join_round):
+        self.sid = sid
+        self.seqs = seqs
+        self.weights = weights
+        self.graph = graph
+        self.is_rc = [False] * len(seqs)
+        self.cursor = 0
+        self.n_reads = len(seqs)
+        self.join_round = join_round
+
+
+def _round_delay_s() -> float:
+    """Test shim: per-round sleep so serve e2e tests can land a joiner at a
+    deterministic round boundary."""
+    try:
+        return float(os.environ.get("ABPOA_TPU_LOCKSTEP_ROUND_DELAY_S", "0"))
+    except ValueError:
+        return 0.0
+
+
 def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                                 weight_sets: List[List[np.ndarray]],
-                                abpt: Params) -> list:
+                                abpt: Params,
+                                churn: Optional[ChurnHook] = None) -> list:
     """Run K independent read sets in split lockstep.
 
-    Returns one entry per set: `(host_graph, is_rc_flags)`, or `None` where
-    that set must re-run on the caller's sequential path (device backtrack
-    divergence) — the same contract as progressive_poa_fused_batch, so the
-    two lockstep implementations are drop-in interchangeable at the
-    flush_lockstep_group call site.
+    Returns one entry per INITIAL set: `(host_graph, is_rc_flags)`, or
+    `None` where that set must re-run on the caller's sequential path
+    (device backtrack divergence) — the same contract as
+    progressive_poa_fused_batch, so the two lockstep implementations are
+    drop-in interchangeable at the flush_lockstep_group call site.
+
+    With a `churn` hook the lane population may change at round
+    boundaries: results (initial sets AND joiners) are additionally
+    delivered through `churn.on_retire` the round each lane finishes, and
+    `churn.on_round` may evict expired lanes or board same-rung joiners.
     """
     from .. import obs
     from ..align.dp_chunk import (build_lockstep_tables, chunk_plane16,
@@ -50,67 +113,112 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
     from . import scheduler
 
     K = len(seq_sets)
-    n_reads = [len(ss) for ss in seq_sets]
     qmax = max((len(s) for ss in seq_sets for s in ss), default=1)
     Qp = qp_rung(qmax)
     _qp, W, _local = plan_chunk_buckets(abpt, qmax)
-    graphs = [POAGraph() for _ in range(K)]
-    is_rc = [[False] * n for n in n_reads]
-    cursor = [0] * K
-    failed = [False] * K
     amb = bool(abpt.amb_strand)
     obs.observe("lockstep.k", K)
+    delay_s = _round_delay_s()
 
-    def fuse_read(k: int, res, qseq, weight) -> None:
-        g = graphs[k]
-        rid = cursor[k]
-        g.add_alignment(abpt, qseq, weight, None, res.cigar, rid,
-                        n_reads[k], True)
-        cursor[k] += 1
+    # the lane table: sid -> live lane, insertion-ordered (deterministic
+    # dispatch packing); capacity is the high-water mark of concurrently
+    # live lanes, so occupancy = live/capacity is comparable with the
+    # static driver's (1 - noop) over the fixed group size
+    lanes: dict = {}
+    seen_sids = set()
+    final: dict = {}
+    initial_sids = list(range(K))
+    for sid in initial_sids:
+        lanes[sid] = _Lane(sid, seq_sets[sid], weight_sets[sid],
+                           POAGraph(), 0)
+        seen_sids.add(sid)
+    capacity = max(len(lanes), 1)
+
+    def retire(lane: _Lane, result, round_i: int) -> None:
+        lanes.pop(lane.sid, None)
+        if isinstance(lane.sid, int) and 0 <= lane.sid < K:
+            final[lane.sid] = result
+        if churn is not None:
+            if lanes:
+                obs.count("lockstep.early_retires")
+            churn.on_retire(lane.sid, result, round_i)
+
+    def fuse_read(lane: _Lane, res, qseq, weight) -> None:
+        lane.graph.add_alignment(abpt, qseq, weight, None, res.cigar,
+                                 lane.cursor, lane.n_reads, True)
+        lane.cursor += 1
 
     round_i = 0
     while True:
-        active = [k for k in range(K)
-                  if not failed[k] and cursor[k] < n_reads[k]]
-        if not active:
+        if delay_s:
+            time.sleep(delay_s)
+        if churn is not None:
+            evict, joiners = churn.on_round(round_i + 1, list(lanes))
+            for sid in evict or ():
+                if lanes.pop(sid, None) is not None:
+                    obs.count("lockstep.evictions")
+            for sid, j_seqs, j_wgts in joiners or ():
+                if sid in seen_sids:
+                    raise ValueError(
+                        f"split lockstep: duplicate lane sid {sid!r}")
+                seen_sids.add(sid)
+                j_qmax = max((len(s) for s in j_seqs), default=1)
+                if not j_seqs or j_qmax + 2 > Qp:
+                    # off-rung (or empty) joiner: never board it — it would
+                    # force a new Qp rung. The hook re-routes it.
+                    churn.on_retire(sid, None, round_i + 1)
+                    continue
+                if j_qmax > qmax:
+                    qmax = j_qmax
+                    _qp2, W2, _l2 = plan_chunk_buckets(abpt, qmax)
+                    W = max(W, W2)
+                lanes[sid] = _Lane(sid, j_seqs, j_wgts, POAGraph(),
+                                   round_i + 1)
+                obs.count("lockstep.joins")
+            capacity = max(capacity, len(lanes))
+        if not lanes:
             break
         t_round = time.perf_counter()
         round_i += 1
         obs.count("lockstep.chunks")
-        # idle-lane fraction: real sets already finished (or failed) out of
-        # K — the divergence signal the scheduler's K cap feeds on
-        noop = 1.0 - len(active) / K
-        obs.observe("lockstep.noop_set_fraction", noop)
-        scheduler.observe_noop_fraction(noop)
-        if noop:
+        # measured lane occupancy: live lanes over the group's high-water
+        # capacity — the scheduler's K-cap input (noop = 1 - occupancy)
+        active = list(lanes.values())
+        occ = len(active) / capacity
+        obs.observe("lockstep.noop_set_fraction", 1.0 - occ)
+        scheduler.observe_lane_occupancy(occ)
+        if occ < 1.0:
             obs.count("lockstep.drain_chunks")
 
-        # first read of a set seeds its graph: fusion only, no DP
+        # first read of a lane seeds its graph: fusion only, no DP
         from ..align.result import AlignResult
-        dp_ks = []
-        done_this_round: List[Tuple[int, int]] = []  # (set, qlen) advanced
-        for k in active:
-            if graphs[k].node_n <= 2:
+        dp_lanes: List[_Lane] = []
+        done_this_round: List[Tuple[int, int]] = []  # (sid, qlen) advanced
+        for lane in active:
+            if lane.graph.node_n <= 2:
                 with obs.phase("fusion"):
-                    done_this_round.append((k, len(seq_sets[k][cursor[k]])))
-                    fuse_read(k, AlignResult(), seq_sets[k][cursor[k]],
-                              weight_sets[k][cursor[k]])
+                    done_this_round.append(
+                        (lane.sid, len(lane.seqs[lane.cursor])))
+                    fuse_read(lane, AlignResult(), lane.seqs[lane.cursor],
+                              lane.weights[lane.cursor])
+                if lane.cursor >= lane.n_reads:
+                    retire(lane, (lane.graph, lane.is_rc), round_i)
             else:
-                dp_ks.append(k)
-        if not dp_ks:
+                dp_lanes.append(lane)
+        if not dp_lanes:
             _record_round(abpt, done_this_round, t_round)
             continue
 
         with obs.phase("align"):
             tables = []
-            for k in dp_ks:
-                q = seq_sets[k][cursor[k]]
-                obs.record_dp(graphs[k].node_n, _band_cols(abpt, len(q)),
+            for lane in dp_lanes:
+                q = lane.seqs[lane.cursor]
+                obs.record_dp(lane.graph.node_n, _band_cols(abpt, len(q)),
                               abpt.gap_mode)
-                tables.append(build_lockstep_tables(graphs[k], abpt, q, Qp))
+                tables.append(build_lockstep_tables(lane.graph, abpt, q, Qp))
             R = plan_row_rung(max(t["n_rows"] for t in tables))
             P = plan_degree_rung(max(t["pre_idx"].shape[1] for t in tables))
-            Kb = k_rung(len(dp_ks))
+            Kb = k_rung(len(dp_lanes))
             plane16 = chunk_plane16(
                 abpt, qmax, max(t["n_rows"] for t in tables))
             # the W-growth retry wraps BOTH dispatches: a band overflow on
@@ -122,8 +230,8 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                                            plane16)
                 results = [result_from_chunk(
                     abpt, packed[i], tables[i],
-                    graphs[k].index_to_node_id) for i, k in
-                    enumerate(dp_ks)]
+                    lane.graph.index_to_node_id) for i, lane in
+                    enumerate(dp_lanes)]
                 overflowed = any(f["overflow"] for _res, f in results)
                 if amb and not overflowed:
                     # ambiguous-strand rescue, host threshold exactly as
@@ -131,21 +239,21 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                     # the reverse complement against the SAME tables (the
                     # graph is untouched until fusion) in one extra
                     # batched dispatch
-                    rc_ks = []
-                    for i, k in enumerate(dp_ks):
+                    rc_is = []
+                    for i, lane in enumerate(dp_lanes):
                         res, _f = results[i]
-                        q = seq_sets[k][cursor[k]]
-                        thr = (min(len(q), graphs[k].node_n - 2)
+                        q = lane.seqs[lane.cursor]
+                        thr = (min(len(q), lane.graph.node_n - 2)
                                * abpt.max_mat * 0.3333)
                         if res.best_score < thr:
-                            rc_ks.append(i)
-                    if rc_ks:
+                            rc_is.append(i)
+                    if rc_is:
                         rc_tables = []
-                        for i in rc_ks:
-                            k = dp_ks[i]
-                            q = seq_sets[k][cursor[k]]
+                        for i in rc_is:
+                            lane = dp_lanes[i]
+                            q = lane.seqs[lane.cursor]
                             rc_q = _rc_encode(q)
-                            obs.record_dp(graphs[k].node_n,
+                            obs.record_dp(lane.graph.node_n,
                                           _band_cols(abpt, len(rc_q)),
                                           abpt.gap_mode)
                             t = dict(tables[i])
@@ -159,11 +267,11 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                             rc_tables.append(t)
                         rc_packed = dispatch_dp_chunk(abpt, rc_tables, Kb,
                                                       R, P, Qp, W, plane16)
-                        for j, i in enumerate(rc_ks):
-                            k = dp_ks[i]
+                        for j, i in enumerate(rc_is):
+                            lane = dp_lanes[i]
                             rc_res, rc_f = result_from_chunk(
                                 abpt, rc_packed[j], rc_tables[j],
-                                graphs[k].index_to_node_id)
+                                lane.graph.index_to_node_id)
                             if rc_f["overflow"]:
                                 overflowed = True
                             elif rc_f["bt_err"]:
@@ -185,27 +293,31 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                     "split lockstep: band growth did not converge")
 
         with obs.phase("fusion"):
-            for i, k in enumerate(dp_ks):
+            for i, lane in enumerate(dp_lanes):
                 res, f = results[i]
                 if f["bt_err"]:
                     # device backtrack diverged: this set re-runs on the
                     # caller's sequential path (same contract as the
-                    # all-device lockstep)
-                    failed[k] = True
+                    # all-device lockstep) — retired NOW, not at group end
                     obs.count("lockstep.split_bt_fallback")
+                    retire(lane, None, round_i)
                     continue
-                q = seq_sets[k][cursor[k]]
-                wgt = weight_sets[k][cursor[k]]
+                q = lane.seqs[lane.cursor]
+                wgt = lane.weights[lane.cursor]
                 if f.get("rc"):
-                    is_rc[k][cursor[k]] = True
+                    lane.is_rc[lane.cursor] = True
                     q = _rc_encode(q)
                     wgt = wgt[::-1].copy()
-                done_this_round.append((k, len(q)))
-                fuse_read(k, res, q, wgt)
+                done_this_round.append((lane.sid, len(q)))
+                fuse_read(lane, res, q, wgt)
+                if lane.cursor >= lane.n_reads:
+                    # finished lanes retire at the round boundary they
+                    # finish: result to its future, slot freed for joiners
+                    retire(lane, (lane.graph, lane.is_rc), round_i)
 
         _record_round(abpt, done_this_round, t_round)
 
-    return [None if failed[k] else (graphs[k], is_rc[k]) for k in range(K)]
+    return [final.get(sid) for sid in initial_sids]
 
 
 def _record_round(abpt: Params, done: List[Tuple[int, int]],

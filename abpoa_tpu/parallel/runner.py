@@ -196,6 +196,42 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
     return results
 
 
+def flush_lockstep_group_churn(group: List, abpt: Params, devices: List,
+                               gi: int, churn) -> None:
+    """Continuous-batching variant of flush_lockstep_group (serve-only):
+    run one same-rung group of (idx, ab, seqs, weights) entries through
+    the SPLIT driver with a round-boundary churn hook. Results are
+    delivered exclusively through ``churn.on_retire`` the round each lane
+    finishes — there is no result dict, because by the time the call
+    returns every lane (initial and joined) has already been answered.
+
+    No length-bucket partition and no memory admission_plan here: the
+    serve coalescer already packs a single Qp rung, and the admission byte
+    gate priced the group (and prices every joiner against the LIVE group
+    via claim_joiners) — a second static plan over the pickup snapshot
+    would be wrong the moment a lane retires. Dispatch failures raise
+    (DispatchFailed/RuntimeError) for the caller's per-job sweep."""
+    if not group:
+        return
+    from ..obs import count, device_capture, observe, trace
+    from .. import resilience as rz
+    from .lockstep import progressive_poa_split_batch
+    count("lockstep.groups")
+    observe("lockstep.group_size", len(group))
+    backend = "jax" if abpt.device == "tpu" else abpt.device
+    dev = devices[gi % len(devices)] if devices else None
+    with trace.span("lockstep_group", "fused",
+                    args={"k": len(group), "group": gi, "impl": "split",
+                          "churn": True}), \
+            device_capture("lockstep_group"):
+        with _default_device(dev):
+            rz.guarded_device_call(
+                "lockstep_batch", backend,
+                lambda: progressive_poa_split_batch(
+                    [e[2] for e in group], [e[3] for e in group],
+                    abpt, churn=churn))
+
+
 def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
               devices: List = None) -> dict:
     """Process independent read-set files (the `-l` mode): lockstep-batched

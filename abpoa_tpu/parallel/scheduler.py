@@ -47,10 +47,26 @@ class Route(NamedTuple):
 _NOOP = {"ewma": 0.0, "seen": False}
 NOOP_HALVING_STEP = 0.25
 
+# EWMA of measured lane occupancy (live lanes / group capacity) fed per
+# round by the split driver's lane table; under churn this is the direct
+# gauge of how full the dispatched rung actually is (joins backfill retired
+# lanes, so it stays near 1.0 instead of decaying with the drain)
+_OCC = {"ewma": 1.0, "seen": False, "sum": 0.0, "n": 0}
+
+# Below this query length serial wins over lockstep on CPU hosts: the
+# per-round host fusion + dispatch overhead isn't amortized by the tiny DP
+# plane (the ~1.5 kb crossover measured in PERF.md round 14 / the
+# lockstep_gate sim sets). plan_route(qlen=...) routes below it to serial.
+LOCKSTEP_MIN_QLEN = 1500
+
 
 def reset() -> None:
     _NOOP["ewma"] = 0.0
     _NOOP["seen"] = False
+    _OCC["ewma"] = 1.0
+    _OCC["seen"] = False
+    _OCC["sum"] = 0.0
+    _OCC["n"] = 0
 
 
 def observe_noop_fraction(f: float) -> None:
@@ -67,6 +83,45 @@ def observe_noop_fraction(f: float) -> None:
 
 def noop_ewma() -> float:
     return _NOOP["ewma"]
+
+
+def observe_lane_occupancy(occ: float) -> None:
+    """Fed by the split driver's lane table once per round: live lanes over
+    group capacity. Publishes the `abpoa_lockstep_lane_occupancy` gauge and
+    feeds the same K-cap EWMA as `observe_noop_fraction` (noop = 1 - occ),
+    so the cap reacts to measured occupancy whether or not churn is on."""
+    occ = min(max(float(occ), 0.0), 1.0)
+    _OCC["ewma"] = occ if not _OCC["seen"] else (
+        0.5 * _OCC["ewma"] + 0.5 * occ)
+    _OCC["seen"] = True
+    _OCC["sum"] += occ
+    _OCC["n"] += 1
+    from ..obs import metrics
+    metrics.publish_lane_occupancy(_OCC["ewma"])
+    observe_noop_fraction(1.0 - occ)
+
+
+def occupancy_ewma() -> float:
+    return _OCC["ewma"]
+
+
+def occupancy_mean() -> float:
+    """Unweighted mean of every per-round occupancy observation since
+    reset(). The EWMA's 0.5 blend makes it a recency gauge — it tracks the
+    tail of a run, which under churn is always the drain of the last open
+    group (no more joiners to backfill). For whole-run comparisons (the
+    churn gate's A/B) the mean is the honest estimator."""
+    return _OCC["sum"] / _OCC["n"] if _OCC["n"] else 1.0
+
+
+def lockstep_min_qlen() -> int:
+    """Serial-vs-lockstep crossover in query bp; ABPOA_TPU_LOCKSTEP_MIN_QLEN
+    overrides (0 disables the qlen gate entirely)."""
+    try:
+        return int(os.environ.get("ABPOA_TPU_LOCKSTEP_MIN_QLEN",
+                                  str(LOCKSTEP_MIN_QLEN)))
+    except ValueError:
+        return LOCKSTEP_MIN_QLEN
 
 
 def noop_k_cap(base_k: int, noop: Optional[float] = None) -> int:
@@ -104,16 +159,23 @@ def lockstep_impl(abpt) -> str:
     return "device" if has_accelerator() else "split"
 
 
-def plan_route(abpt, n_sets: int, serve: bool = False) -> Route:
+def plan_route(abpt, n_sets: int, serve: bool = False,
+               qlen: Optional[int] = None) -> Route:
     """THE batch/serve dispatch decision: device inventory (accelerator vs
     CPU, core count via pool.resolve_workers), lockstep eligibility
     (config scope + opt-in), and the noop-fraction K cap, in one place.
 
     serve=True plans the coalescing path: pool-vs-serial is the server's
     own --pool-workers knob, so only serial/lockstep come back.
+
+    qlen, when known, is the batch's max query length: below the measured
+    ~1.5 kb crossover (lockstep_min_qlen) the per-round fusion + dispatch
+    overhead loses to serial even with lockstep enabled, so such sets
+    route serial/pool rather than occupying a lockstep group.
     """
     from .runner import _lockstep_ok, lockstep_group_size
-    route = _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size)
+    route = _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
+                  qlen)
     from ..obs import count, metrics, trace
     count(f"scheduler.{route.kind}")
     metrics.publish_route(route)
@@ -123,19 +185,26 @@ def plan_route(abpt, n_sets: int, serve: bool = False) -> Route:
     return route
 
 
-def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size) -> Route:
+def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
+          qlen=None) -> Route:
     if n_sets <= 0:
         return Route("serial", "", 1, 1, "empty batch")
-    if not _lockstep_ok(abpt):
+    min_q = lockstep_min_qlen()
+    below_crossover = qlen is not None and qlen < min_q
+    if not _lockstep_ok(abpt) or below_crossover:
+        why = (f"qlen {qlen} < serial-wins crossover {min_q}"
+               if below_crossover else "lockstep ineligible")
         if serve:
-            return Route("serial", "", 1, 1, "lockstep ineligible")
+            return Route("serial", "", 1, 1, why)
         from .pool import resolve_workers
         w = resolve_workers(abpt, n_sets)
         if w > 1 and n_sets > 1:
             return Route("pool", "", 1, w,
-                         f"{w} workers over {n_sets} sets (CPU multicore)")
+                         f"{w} workers over {n_sets} sets (CPU multicore)"
+                         + (f"; {why}" if below_crossover else ""))
         return Route("serial", "", 1, 1,
-                     "single set/core, or lockstep ineligible")
+                     why if below_crossover
+                     else "single set/core, or lockstep ineligible")
     impl = lockstep_impl(abpt)
     base_k = lockstep_group_size()
     k_cap = noop_k_cap(base_k)

@@ -17,6 +17,12 @@ Two limits, both checked BEFORE a request costs anything:
 Rejections carry a Retry-After derived from the observed service rate
 (queue depth x recent mean service time), so a well-behaved client backs
 off proportionally to the actual backlog.
+
+Continuous batching (PR 17) adds a second exit from the queue:
+`claim_joiners` lets an in-flight lockstep group pull same-rung jobs onto
+freed lanes at a round boundary, priced against the group's LIVE byte
+footprint (early-retired lanes have already released their share) rather
+than the pickup-time snapshot.
 """
 from __future__ import annotations
 
@@ -77,11 +83,12 @@ class Job:
     __slots__ = ("id", "label", "records", "n_reads", "rung", "est_bytes",
                  "eligible", "deadline_s", "t_arrive", "done", "status",
                  "body", "error", "_lock", "_done_marked",
-                 "rid", "t_pickup", "dumps", "attempt")
+                 "rid", "t_pickup", "dumps", "attempt", "qmax",
+                 "join_round", "join_group")
 
     def __init__(self, records, rung: int, est_bytes: int, eligible: bool,
                  deadline_s: float, rid: str = "",
-                 attempt: int = 1) -> None:
+                 attempt: int = 1, qmax: int = 0) -> None:
         self.id = next(self._ids)
         self.label = f"req-{self.id}"
         # the request id minted at ingress (PR 15): rides the response
@@ -95,6 +102,14 @@ class Job:
         self.attempt = max(1, attempt)
         self.t_pickup: Optional[float] = None   # set when a worker pops us
         self.dumps: list = []                   # harvested flight dumps
+        # raw max query length (bp): the scheduler's serial-vs-lockstep
+        # crossover input — rung alone is too coarse (geom-128 snapped)
+        self.qmax = qmax
+        # continuous batching (PR 17): set when this request boarded an
+        # in-flight lockstep group at a round boundary instead of being
+        # coalesced at pickup — `why` renders "joined group g at round r"
+        self.join_round: Optional[int] = None
+        self.join_group: Optional[int] = None
         self.records = records
         self.n_reads = len(records)
         self.rung = rung
@@ -181,10 +196,14 @@ class AdmissionController:
 
     # ------------------------------------------------------------- workers
     def next_group(self, max_k: int = 1, coalesce: bool = False,
-                   timeout: float = 0.25) -> List[Job]:
+                   timeout: float = 0.25, min_qlen: int = 0) -> List[Job]:
         """Pop the head job, plus (when coalescing) up to max_k-1 more
         queued jobs sharing its Qp rung — the lockstep pack. Returns []
-        on timeout or closed-and-empty so workers can re-check shutdown."""
+        on timeout or closed-and-empty so workers can re-check shutdown.
+
+        min_qlen is the scheduler's serial-vs-lockstep crossover: a head
+        below it runs serial, so coalescing it into a lockstep pack would
+        only slow it down (jobs with unknown qmax=0 are not gated)."""
         with self._cv:
             if not self._queue:
                 if self._closed:
@@ -194,6 +213,8 @@ class AdmissionController:
                     return []
             head = self._queue.popleft()
             group = [head]
+            if coalesce and head.qmax and head.qmax < min_qlen:
+                coalesce = False
             if coalesce and head.eligible and max_k > 1:
                 for job in list(self._queue):
                     if len(group) >= max_k:
@@ -210,6 +231,45 @@ class AdmissionController:
                 job.t_pickup = now
             self._publish_locked()
             return group
+
+    def claim_joiners(self, rung: int, max_n: int,
+                      live_bytes: int = 0,
+                      min_remaining_s: float = 0.5) -> List[Job]:
+        """Continuous batching (PR 17): pull up to max_n queued jobs onto
+        the free lanes of an in-flight lockstep group at its round
+        boundary. A joiner must share the group's Qp rung, be lockstep-
+        eligible, have at least min_remaining_s of deadline left (a
+        near-dead request boarding a multi-round group would just 504 on a
+        lane), and fit the byte budget priced against the LIVE group
+        (live_bytes = sum over the group's currently-live lanes — early
+        retires have already released their share), not the pickup-time
+        snapshot. Claimed jobs leave the queue and count in-flight, same
+        accounting as next_group."""
+        claimed: List[Job] = []
+        with self._cv:
+            if max_n <= 0:
+                return claimed
+            priced = live_bytes
+            for job in list(self._queue):
+                if len(claimed) >= max_n:
+                    break
+                if not job.eligible or job.rung != rung:
+                    continue
+                if job.remaining_s() <= min_remaining_s:
+                    continue
+                if (self._budget and priced > 0
+                        and priced + job.est_bytes > self._budget):
+                    continue
+                self._queue.remove(job)
+                priced += job.est_bytes
+                claimed.append(job)
+            if claimed:
+                self._inflight += len(claimed)
+                now = time.perf_counter()
+                for job in claimed:
+                    job.t_pickup = now
+                self._publish_locked()
+        return claimed
 
     def mark_done(self, job: Job, service_s: Optional[float] = None) -> None:
         """Release one job's accounting. Idempotent per job: the worker's

@@ -113,16 +113,41 @@ class ReplicaView:
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "local_inflight": self.local_inflight}
 
+    def open_group_rungs(self) -> set:
+        """Rungs with a boardable in-flight lockstep group on this replica
+        (free lane + continuous batching on), from the last scraped
+        /healthz `open_groups` block. A request placed here joins at the
+        group's next round boundary instead of waiting out a fresh one."""
+        try:
+            return {int(g["rung"]) for g in self.health.get(
+                "open_groups") or () if int(g.get("free") or 0) > 0}
+        except (TypeError, ValueError, KeyError):
+            return set()
+
 
 def plan_placement(views: List[ReplicaView],
                    rung: Optional[int] = None) -> List[ReplicaView]:
     """Candidate order for one request: ready, non-draining replicas by
     ascending observed load (scraped queue depth + inflight + the
-    router's own unanswered sends), rung affinity breaking ties."""
+    router's own unanswered sends), rung affinity breaking ties.
+
+    Affinity is three-tiered (PR 17): a replica advertising an OPEN
+    same-rung lockstep group with a free lane (healthz `open_groups`)
+    outranks one that merely served this rung last (warm compile cache),
+    which outranks the rest — a request placed on tier 0 boards an
+    in-flight group at its next round boundary. Load still dominates:
+    affinity never outranks a shorter queue."""
     ready = [v for v in views if v.ready and not v.draining]
 
     def key(v: ReplicaView):
-        affinity = 0 if (rung is not None and v.last_rung == rung) else 1
+        if rung is None:
+            affinity = 2
+        elif rung in v.open_group_rungs():
+            affinity = 0
+        elif v.last_rung == rung:
+            affinity = 1
+        else:
+            affinity = 2
         return (v.queue_depth + v.inflight + v.local_inflight,
                 affinity, v.name)
 

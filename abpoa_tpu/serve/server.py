@@ -89,6 +89,15 @@ def replica_name() -> Optional[str]:
     return os.environ.get("ABPOA_TPU_REPLICA") or None
 
 
+def churn_enabled_env() -> bool:
+    """Continuous batching (PR 17): may in-flight split-lockstep groups
+    accept same-rung joiners at round boundaries? Default on whenever the
+    split lockstep path serves; ABPOA_TPU_SERVE_CHURN=0 pins the static
+    pickup-time-only coalescing (the churn_gate baseline)."""
+    return os.environ.get("ABPOA_TPU_SERVE_CHURN", "1").strip().lower() \
+        not in ("0", "off", "false")
+
+
 # inbound request ids (fleet router hop) must look like our own minted
 # ids: hex-ish tokens, bounded — anything else is ignored and re-minted
 _RID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
@@ -133,7 +142,143 @@ def _request_record(job: Job, status: str, device: str) -> dict:
     if rep:
         rec["replica"] = rep
     rec["attempt"] = job.attempt
+    if job.join_round is not None:
+        # continuous batching: this request boarded an in-flight lockstep
+        # group at a round boundary — `why` names the round it boarded
+        # (the pickup-time coalesced_k tag is stale under churn)
+        rec["join_round"] = job.join_round
+        rec["join_group"] = job.join_group
     return rec
+
+
+class _ServeChurnHook:
+    """Round-boundary churn driver for ONE in-flight serve lockstep group
+    (parallel/lockstep.ChurnHook protocol). Per round it evicts lanes
+    whose deadline expired (504 at the boundary, not at group end), claims
+    same-rung joiners from the admission queue onto freed lanes — priced
+    against the LIVE group's bytes — and finishes each job the round its
+    lane retires. Runs entirely on the worker thread driving the group."""
+
+    def __init__(self, server: "AlignServer", abpt: Params, gid: int,
+                 rung: int, k_cap: int) -> None:
+        import itertools
+        self.server = server
+        self.abpt = abpt
+        self.gid = gid
+        self.rung = rung
+        self.k_cap = max(1, k_cap)
+        self.jobs: Dict[object, Job] = {}    # sid -> live job
+        self.abs: Dict[object, object] = {}  # sid -> Abpoa container
+        self.fallbacks: List[Job] = []       # need the sequential path
+        self.closed = False
+        self._sids = itertools.count(10_000)  # joiner sids, clear of 0..K-1
+
+    def add_initial(self, sid, job: Job, ab) -> None:
+        self.jobs[sid] = job
+        self.abs[sid] = ab
+
+    def live_bytes(self) -> int:
+        return sum(j.est_bytes for j in self.jobs.values())
+
+    # ------------------------------------------------- ChurnHook protocol
+    def on_round(self, round_i: int, live_sids: list) -> tuple:
+        server = self.server
+        evict = set()
+        for sid in live_sids:
+            job = self.jobs.get(sid)
+            if job is not None and job.remaining_s() <= 0:
+                evict.add(sid)
+                self.jobs.pop(sid, None)
+                self.abs.pop(sid, None)
+                obs.record_fault(
+                    "request_timeout", detail=job.label,
+                    action="evicted_at_round",
+                    extra={"request_id": job.rid} if job.rid else None)
+                if job.finish("timeout",
+                              error="request deadline expired "
+                                    f"(evicted at round {round_i})"):
+                    server.account(job, "timeout")
+                server.admission.mark_done(job)
+        free = self.k_cap - (len(live_sids) - len(evict))
+        joiners = []
+        if free > 0 and not self.closed and not server.admission.closed:
+            claimed = server.admission.claim_joiners(
+                self.rung, free, live_bytes=self.live_bytes())
+            for job in claimed:
+                boarded = self._board(job, round_i)
+                if boarded is not None:
+                    joiners.append(boarded)
+        server._open_group_update(
+            self.gid, self.rung,
+            self.k_cap - (len(live_sids) - len(evict)) - len(joiners),
+            round_i, len(live_sids) - len(evict) + len(joiners))
+        return evict, joiners
+
+    def _board(self, job: Job, round_i: int):
+        """Ingest one claimed joiner onto a lane; returns the driver
+        (sid, seqs, weights) tuple or None (poisoned -> 400 here)."""
+        from ..pipeline import Abpoa, _ingest_records
+        from ..resilience import QUARANTINE_EXCEPTIONS
+        from ..obs import metrics
+        server = self.server
+        try:
+            ab = Abpoa()
+            seqs, weights = _ingest_records(ab, self.abpt, job.records)
+        except QUARANTINE_EXCEPTIONS as e:
+            obs.record_fault("poisoned_set", detail=str(e)[:300],
+                             action="rejected_400")
+            if job.finish("poisoned", error=f"{type(e).__name__}: {e}"):
+                server.account(job, "poisoned")
+            server.admission.mark_done(job)
+            return None
+        sid = next(self._sids)
+        self.jobs[sid] = job
+        self.abs[sid] = ab
+        job.join_round = round_i
+        job.join_group = self.gid
+        wait = max(0.0, (job.t_pickup or time.perf_counter())
+                   - job.t_arrive)
+        metrics.publish_join_wait(wait)
+        if obs.trace_enabled():
+            obs.trace.add_span(
+                "admission_wait", "serve", job.t_arrive, wait,
+                args={"coalesced_k": len(self.jobs), "rung": job.rung,
+                      "join_round": round_i, "join_group": self.gid},
+                req=(job.rid, 0) if job.rid else None)
+        return (sid, seqs, weights)
+
+    def on_retire(self, sid, result, round_i: int) -> None:
+        from ..pipeline import output
+        server = self.server
+        job = self.jobs.pop(sid, None)
+        ab = self.abs.pop(sid, None)
+        if job is None:
+            return
+        service = max(0.0, time.perf_counter()
+                      - (job.t_pickup or job.t_arrive))
+        if result is None:
+            # backtrack divergence (or off-rung reject): sequential path,
+            # swept by _run_lockstep_churn after the group returns
+            self.fallbacks.append(job)
+            return
+        try:
+            pg, is_rc = result
+            ab.graph = pg
+            if self.abpt.amb_strand:
+                for j, flag in enumerate(is_rc):
+                    ab.is_rc[j] = flag
+            ab.seqs = [""] * len(ab.seqs)
+            buf = io.StringIO()
+            output(ab, self.abpt, buf)
+            if job.finish("ok", body=buf.getvalue()):
+                server.account(job, "ok")
+        except Exception as e:  # noqa: BLE001 — group must survive
+            obs.record_fault("request_error", detail=str(e)[:300],
+                             action="rejected_500")
+            if job.finish("error", error=f"{type(e).__name__}: {e}"):
+                server.account(job, "error")
+        finally:
+            server.admission.mark_done(job, service)
 
 
 class AlignServer:
@@ -177,6 +322,13 @@ class AlignServer:
         self._devices = None        # jax devices, set after warm
         self._lockstep = False
         self._lockstep_impl = ""    # "split" | "device" once routed
+        # continuous batching (PR 17): in-flight split-lockstep groups
+        # accept same-rung joiners at round boundaries. The open-group
+        # registry backs /healthz's `open_groups` block (fleet routers
+        # prefer replicas with a boardable group on the request's rung).
+        self._churn = False
+        self._open_groups: Dict[int, dict] = {}
+        self._open_lock = threading.Lock()
         import itertools
         self._group_ids = itertools.count()  # atomic across workers
         self.t_start = time.time()
@@ -237,6 +389,11 @@ class AlignServer:
                                    serve=True)
                 self._lockstep = route.kind == "lockstep"
                 self._lockstep_impl = route.impl
+                # churn needs the split driver's host-side round
+                # boundaries (the all-device loop has none to board at)
+                self._churn = (self._lockstep
+                               and self._lockstep_impl == "split"
+                               and churn_enabled_env())
             else:
                 print("[abpoa-tpu serve] Warning: JAX backend probe timed "
                       "out; serving on the host engine.", file=sys.stderr)
@@ -251,6 +408,7 @@ class AlignServer:
             # coalesced lockstep groups stay in-process; the pool is the
             # per-request containment backend (CPU hosts foremost)
             self._lockstep = False
+            self._churn = False
         for i in range(self._n_workers):
             t = threading.Thread(target=self._worker_loop, daemon=True,
                                  name=f"abpoa-serve-worker-{i}")
@@ -368,7 +526,28 @@ class AlignServer:
             # worker pids included so an operator (or the smoke harness)
             # can kill a worker and watch the supervisor respawn it
             out["pool"] = self._pool.snapshot()
+        if self._churn:
+            # boardable in-flight lockstep groups: the fleet router's
+            # rung-affinity signal (plan_placement prefers a replica whose
+            # open group can seat the request's rung without a new group)
+            out["open_groups"] = self.open_groups_snapshot()
         return out
+
+    # ------------------------------------------------- open-group registry
+    def _open_group_update(self, gid: int, rung: int, free: int,
+                           round_i: int, live: int) -> None:
+        with self._open_lock:
+            self._open_groups[gid] = {"id": gid, "rung": rung,
+                                      "free": max(0, free),
+                                      "round": round_i, "live": live}
+
+    def _open_group_close(self, gid: int) -> None:
+        with self._open_lock:
+            self._open_groups.pop(gid, None)
+
+    def open_groups_snapshot(self) -> List[dict]:
+        with self._open_lock:
+            return [dict(g) for g in self._open_groups.values()]
 
     # ---------------------------------------------------------- execution
     def _worker_loop(self) -> None:
@@ -379,8 +558,10 @@ class AlignServer:
             # divergence feedback: measured noop_set_fraction re-caps the
             # next coalesced group's K (scheduler.noop_k_cap)
             max_k = (_sched.noop_k_cap(base_k) if self._lockstep else 1)
-            group = self.admission.next_group(max_k=max_k,
-                                              coalesce=self._lockstep)
+            group = self.admission.next_group(
+                max_k=max_k, coalesce=self._lockstep,
+                min_qlen=(_sched.lockstep_min_qlen()
+                          if self._lockstep else 0))
             if not group:
                 # intake closed + queue empty = no work can ever arrive
                 # again: exit NOW, even while a sibling worker still has
@@ -435,6 +616,14 @@ class AlignServer:
         # per-group Params copy: msa() mutates its Params (device reroute,
         # batch bookkeeping) and workers run concurrently
         abpt = copy.deepcopy(self.abpt)
+        if self._churn and all(j.eligible for j in live):
+            from ..parallel import scheduler as _sched
+            head = live[0]
+            # below the serial-wins crossover a lockstep lane only slows
+            # the request down — static serial path, no group to board
+            if not head.qmax or head.qmax >= _sched.lockstep_min_qlen():
+                self._run_lockstep_churn(live, abpt)
+                return
         if len(live) > 1:
             self._run_lockstep(live, abpt)
             return
@@ -639,6 +828,67 @@ class AlignServer:
             finally:
                 self.admission.mark_done(job, share)
 
+    def _run_lockstep_churn(self, jobs: List[Job], abpt: Params) -> None:
+        """Continuous batching: run the picked group through the split
+        driver with a round-boundary churn hook. Lanes retire the round
+        they finish (their jobs are answered mid-group), expired lanes are
+        evicted as boundary 504s, and same-rung queue arrivals board freed
+        lanes (admission.claim_joiners, live-group byte pricing) — the
+        group keeps serving as long as compatible work keeps arriving.
+        Accepts a single-job group: it OPENS a group that later arrivals
+        join, which is the whole point. No outer call_with_deadline: the
+        per-lane boundary eviction answers individual deadlines, and a
+        wedged dispatch is contained by the dispatch-level watchdog inside
+        guarded_device_call (failure -> per-job sweep below)."""
+        from ..pipeline import Abpoa, _ingest_records
+        from ..resilience import DispatchFailed, QUARANTINE_EXCEPTIONS
+        from ..parallel import flush_lockstep_group_churn
+        entries = []
+        gi = next(self._group_ids)
+        from ..parallel import lockstep_group_size
+        from ..parallel import scheduler as _sched
+        hook = _ServeChurnHook(self, abpt, gi, jobs[0].rung,
+                               _sched.noop_k_cap(lockstep_group_size()))
+        for i, job in enumerate(jobs):
+            try:
+                ab = Abpoa()
+                seqs, weights = _ingest_records(ab, abpt, job.records)
+                entries.append((i, ab, seqs, weights))
+                hook.add_initial(i, job, ab)
+            except QUARANTINE_EXCEPTIONS as e:
+                obs.record_fault("poisoned_set", detail=str(e)[:300],
+                                 action="rejected_400")
+                if job.finish("poisoned", error=f"{type(e).__name__}: {e}"):
+                    self.account(job, "poisoned")
+                self.admission.mark_done(job)
+        if not entries:
+            return
+        self._open_group_update(gi, hook.rung,
+                                hook.k_cap - len(entries), 0, len(entries))
+        try:
+            flush_lockstep_group_churn(entries, abpt, self._devices, gi,
+                                       hook)
+        except (DispatchFailed, RuntimeError) as e:
+            print(f"Warning: churn lockstep group {gi} failed ({e}); "
+                  "sweeping members to the sequential path.",
+                  file=sys.stderr)
+            obs.count("fallback.lockstep_to_sequential")
+        finally:
+            hook.closed = True
+            self._open_group_close(gi)
+        # sweep: bt-err fallbacks, plus any lane the dispatch failure left
+        # unanswered — each runs the sequential path under its own
+        # remaining deadline (_finish_single answers 504 when spent)
+        leftovers = hook.fallbacks + list(hook.jobs.values())
+        hook.fallbacks = []
+        hook.jobs.clear()
+        hook.abs.clear()
+        for job in leftovers:
+            try:
+                self._finish_single(job, copy.deepcopy(self.abpt))
+            finally:
+                self.admission.mark_done(job)
+
 
 def _make_handler(server: AlignServer):
     from http.server import BaseHTTPRequestHandler
@@ -802,7 +1052,8 @@ def _make_handler(server: AlignServer):
             return Job(records, rung=qp_rung(qmax),
                        est_bytes=estimate_bytes(caps),
                        eligible=fused_eligible(server.abpt, len(records)),
-                       deadline_s=deadline, rid=rid, attempt=attempt)
+                       deadline_s=deadline, rid=rid, attempt=attempt,
+                       qmax=qmax)
 
     return Handler
 

@@ -43,12 +43,15 @@ class StubReplica:
     without a status line (what a SIGKILLed replica looks like)."""
 
     def __init__(self, name, mode="ok", delay=0.0, retry_after="7",
-                 queue_depth=0):
+                 queue_depth=0, open_groups=None):
         self.name = name
         self.mode = mode
         self.delay = delay
         self.retry_after = retry_after
         self.queue_depth = queue_depth
+        # continuous batching: boardable in-flight lockstep groups this
+        # replica advertises (/healthz open_groups block)
+        self.open_groups = open_groups
         self.seen = []          # (rid, attempt) per POST /align received
         self._lock = threading.Lock()
         stub = self
@@ -72,9 +75,11 @@ class StubReplica:
                 if self.path == "/readyz":
                     self._send(200, b'{"status": "ready"}')
                 elif self.path == "/healthz":
-                    self._send(200, json.dumps(
-                        {"status": "ok", "queue_depth": stub.queue_depth,
-                         "inflight": 0, "replica": stub.name}).encode())
+                    doc = {"status": "ok", "queue_depth": stub.queue_depth,
+                           "inflight": 0, "replica": stub.name}
+                    if stub.open_groups is not None:
+                        doc["open_groups"] = stub.open_groups
+                    self._send(200, json.dumps(doc).encode())
                 elif self.path == "/metrics":
                     text = ("# HELP stub_requests_total served\n"
                             "# TYPE stub_requests_total counter\n"
@@ -173,6 +178,51 @@ def test_plan_placement_orders_by_load_then_rung_affinity():
     c.draining = True
     a.ready = False
     assert [v.name for v in plan_placement([a, b, c], rung=256)] == ["r1"]
+
+
+def test_plan_placement_prefers_open_same_rung_group():
+    """Continuous batching (PR 17): a replica advertising a boardable
+    in-flight group on the request's rung outranks one that merely served
+    the rung last — the request joins at the next round boundary instead
+    of waiting out a fresh group. Load still dominates affinity."""
+    from abpoa_tpu.serve.router import ReplicaView, plan_placement
+    warm = ReplicaView("r0", "http://x:1")
+    boardable = ReplicaView("r1", "http://x:2")
+    cold = ReplicaView("r2", "http://x:3")
+    for v in (warm, boardable, cold):
+        v.ready = True
+    warm.last_rung = 256
+    boardable.health = {"open_groups": [
+        {"id": 3, "rung": 256, "free": 2, "round": 5, "live": 6}]}
+    order = [v.name for v in plan_placement([warm, boardable, cold],
+                                            rung=256)]
+    assert order == ["r1", "r0", "r2"]
+    # a full group (free=0) is not boardable: warm-cache affinity wins
+    boardable.health = {"open_groups": [
+        {"id": 3, "rung": 256, "free": 0, "round": 5, "live": 8}]}
+    assert [v.name for v in plan_placement(
+        [warm, boardable, cold], rung=256)][0] == "r0"
+    # an open group on a DIFFERENT rung gives no affinity either
+    boardable.health = {"open_groups": [
+        {"id": 3, "rung": 512, "free": 2, "round": 5, "live": 6}]}
+    assert [v.name for v in plan_placement(
+        [warm, boardable, cold], rung=256)][0] == "r0"
+    # open-group affinity never outranks load
+    boardable.health = {"open_groups": [
+        {"id": 3, "rung": 256, "free": 2, "round": 5, "live": 6}]}
+    boardable.queue_depth = 9
+    assert [v.name for v in plan_placement(
+        [warm, boardable, cold], rung=256)][0] == "r0"
+
+
+def test_router_polls_open_groups_block(stub_router):
+    """The health poller stores the full /healthz doc, so a stub replica's
+    open_groups block is visible to placement through the poll path."""
+    s0 = StubReplica("r0", open_groups=[
+        {"id": 1, "rung": 128, "free": 3, "round": 2, "live": 5}])
+    r = stub_router(s0)
+    v = r.views()[0]
+    assert v.open_group_rungs() == {128}
 
 
 def test_router_routes_to_ready_replica_with_attribution(stub_router):

@@ -256,6 +256,129 @@ def test_split_lockstep_via_run_batch(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# continuous batching: round-boundary lane churn (round 17)             #
+# --------------------------------------------------------------------- #
+
+class _ScriptedChurn:
+    """Test hook: boards scripted joiners / evicts lanes at fixed round
+    boundaries and records every retire delivery as (result, round)."""
+
+    def __init__(self, joins=None, evict_at=None):
+        self.joins = dict(joins or {})
+        self.evict_at = dict(evict_at or {})
+        self.retired = {}
+        self.rounds = []
+
+    def on_round(self, round_i, live_sids):
+        self.rounds.append((round_i, list(live_sids)))
+        return (self.evict_at.pop(round_i, set()),
+                self.joins.pop(round_i, []))
+
+    def on_retire(self, sid, result, round_i):
+        assert sid not in self.retired, f"double retire for lane {sid}"
+        self.retired[sid] = (result, round_i)
+
+
+def _consensus_text(abpt, pg, n_reads):
+    from abpoa_tpu.cons.consensus import generate_consensus
+    from abpoa_tpu.io.output import output_fx_consensus
+    cons = generate_consensus(pg, abpt, n_reads)
+    buf = io.StringIO()
+    output_fx_consensus(cons, abpt, buf)
+    return buf.getvalue()
+
+
+@pytest.mark.parametrize("join_round", [1, 4, 8],
+                         ids=["first", "mid", "last"])
+def test_split_lockstep_churn_join_parity(join_round):
+    """Lane-churn parity grid: a joiner boarding at the first / a mid /
+    the last round of a divergent-length group is byte-identical to its
+    solo numpy oracle, the initial sets stay byte-identical, and the
+    short set retires EARLY (the round its last read fuses), not at
+    group end."""
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    rng = np.random.default_rng(2026)
+    seq_sets, weight_sets = _random_sets(rng, [3, 8])
+    # qlen_hi=120 keeps the joiner on the group's Qp rung (>= 128) always
+    j_sets, j_wsets = _random_sets(rng, [4], qlen_hi=120)
+    hook = _ScriptedChurn(
+        joins={join_round: [(100, j_sets[0], j_wsets[0])]})
+    abpt = _params(device="jax")
+    outs = progressive_poa_split_batch(seq_sets, weight_sets, abpt,
+                                       churn=hook)
+    for i in (0, 1):
+        assert outs[i] is not None
+        pg, _rc = outs[i]
+        got = _consensus_text(abpt, pg, len(seq_sets[i]))
+        assert got == _host_graph_consensus({}, seq_sets[i],
+                                            weight_sets[i]), i
+    # 3-read lane retires at round 3, 8-read lane at round 8
+    assert hook.retired[0][1] == 3
+    assert hook.retired[1][1] == 8
+    # the joiner's result arrives only via the hook: seeded the round it
+    # boards, one DP round per remaining read
+    res, r = hook.retired[100]
+    assert res is not None and r == join_round + 3
+    pg, _rc = res
+    got = _consensus_text(abpt, pg, len(j_sets[0]))
+    assert got == _host_graph_consensus({}, j_sets[0], j_wsets[0])
+
+
+def test_split_lockstep_churn_amb_strand_joiner():
+    """An ambiguous-strand set boarding mid-flight rides the batched
+    rc-rescue dispatch like any initial lane: rc annotations and emitted
+    bytes match the host loop exactly."""
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    from abpoa_tpu.pipeline import Abpoa, _ingest_records, poa
+    path = os.path.join(DATA_DIR, "rcmix.fa")
+    abpt = _params(amb_strand=1)
+    seqs, weights = _ingest_records(Abpoa(), abpt, read_fastx(path))
+    hook = _ScriptedChurn(joins={2: [(7, seqs, weights)]})
+    outs = progressive_poa_split_batch([seqs], [weights], abpt, churn=hook)
+    abpt_h = _params(device="numpy", amb_strand=1)
+    ab = Abpoa()
+    for r in seqs:
+        ab.append_read(seq="x" * len(r))
+    poa(ab, abpt_h, seqs, weights, 0)
+    assert any(ab.is_rc), "fixture no longer exercises the rc path"
+    assert outs[0] is not None and outs[0][1] == ab.is_rc
+    res, _r = hook.retired[7]
+    assert res is not None and res[1] == ab.is_rc
+    want = _host_graph_consensus({"amb_strand": 1}, seqs, weights)
+    assert _consensus_text(abpt, outs[0][0], len(seqs)) == want
+    assert _consensus_text(abpt, res[0], len(seqs)) == want
+
+
+def test_split_lockstep_churn_evict_and_off_rung():
+    """Boundary eviction drops a lane without a result (the hook owns
+    answering it); an off-rung joiner is rejected via on_retire(None)
+    instead of forcing a new Qp compile rung; a duplicate sid raises."""
+    from abpoa_tpu.compile.ladder import qp_rung
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    rng = np.random.default_rng(5)
+    seq_sets, weight_sets = _random_sets(rng, [3, 5])
+    Qp = qp_rung(max(len(s) for ss in seq_sets for s in ss))
+    long_read = rng.integers(0, 4, Qp + 10).astype(np.uint8)
+    hook = _ScriptedChurn(
+        joins={2: [(50, [long_read],
+                    [np.ones(len(long_read), np.int64)])]},
+        evict_at={2: {0}})
+    abpt = _params(device="jax")
+    outs = progressive_poa_split_batch(seq_sets, weight_sets, abpt,
+                                       churn=hook)
+    assert outs[0] is None and 0 not in hook.retired
+    assert hook.retired[50] == (None, 2)
+    pg, _rc = outs[1]
+    assert _consensus_text(abpt, pg, len(seq_sets[1])) == \
+        _host_graph_consensus({}, seq_sets[1], weight_sets[1])
+    hook2 = _ScriptedChurn(joins={1: [(0, seq_sets[0], weight_sets[0])]})
+    with pytest.raises(ValueError):
+        progressive_poa_split_batch(seq_sets, weight_sets, abpt,
+                                    churn=hook2)
+
+
+# --------------------------------------------------------------------- #
 # scheduler                                                             #
 # --------------------------------------------------------------------- #
 
@@ -327,6 +450,60 @@ def test_scheduler_metrics_and_top_panel():
     frame = render_frame(samples, types, "test.prom", 0.0)
     assert "sched" in frame and "route lockstep" in frame
     assert "noop 0.50" in frame
+    scheduler.reset()
+
+
+def test_scheduler_lane_occupancy_feeds_k_cap():
+    """Measured lane occupancy replaces the reactive noop EWMA: one gauge
+    (`abpoa_lockstep_lane_occupancy`), and the same K-cap feedback path
+    (noop = 1 - occupancy) caps the next groups."""
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.parallel import scheduler
+    M.reset_registry()
+    scheduler.reset()
+    abpt = _params(device="jax", lockstep="on")
+    r_full = scheduler.plan_route(abpt, 8)
+    scheduler.observe_lane_occupancy(0.4)
+    assert scheduler.occupancy_ewma() == pytest.approx(0.4)
+    assert scheduler.noop_ewma() == pytest.approx(0.6)
+    scheduler.observe_lane_occupancy(0.4)
+    r_capped = scheduler.plan_route(abpt, 8)
+    assert r_capped.k_cap < r_full.k_cap
+    text = M.registry().render()
+    assert not M.lint_exposition(text), M.lint_exposition(text)
+    samples, _types = M.parse_exposition(text)
+    assert M.sample_value(
+        samples, "abpoa_lockstep_lane_occupancy") == pytest.approx(0.4)
+    # the run-mean (churn_gate's A/B estimator) weights every round equally
+    # where the EWMA chases the tail: after 0.4, 0.4, 1.0 the EWMA has
+    # recovered to 0.7 but the mean reads the whole run's 0.6
+    scheduler.observe_lane_occupancy(1.0)
+    assert scheduler.occupancy_ewma() == pytest.approx(0.7)
+    assert scheduler.occupancy_mean() == pytest.approx(0.6)
+    scheduler.reset()
+    assert scheduler.occupancy_mean() == pytest.approx(1.0)
+
+
+def test_scheduler_qlen_crossover(monkeypatch):
+    """Satellite 1: a 500 bp serve batch routes SERIAL below the measured
+    ~1.5 kb crossover even with lockstep on; ABPOA_TPU_LOCKSTEP_MIN_QLEN
+    overrides (0 disables the gate)."""
+    from abpoa_tpu.parallel import scheduler
+    scheduler.reset()
+    abpt = _params(device="jax", lockstep="on")
+    r = scheduler.plan_route(abpt, 4, serve=True, qlen=500)
+    assert r.kind == "serial" and "crossover" in r.reason
+    r = scheduler.plan_route(abpt, 4, serve=True, qlen=2000)
+    assert r.kind == "lockstep" and r.impl == "split"
+    # unknown qlen -> no gate (batch runner reads whole files up front)
+    r = scheduler.plan_route(abpt, 4, serve=True)
+    assert r.kind == "lockstep"
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP_MIN_QLEN", "0")
+    r = scheduler.plan_route(abpt, 4, serve=True, qlen=500)
+    assert r.kind == "lockstep"
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP_MIN_QLEN", "300")
+    r = scheduler.plan_route(abpt, 4, serve=True, qlen=250)
+    assert r.kind == "serial"
     scheduler.reset()
 
 

@@ -473,3 +473,58 @@ def test_pool_backend_contains_worker_kill(monkeypatch):
         assert pool["kills"] == 1
     finally:
         assert srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# continuous batching (PR 17): late join at a round boundary             #
+# --------------------------------------------------------------------- #
+
+def test_serve_lockstep_late_join_byte_identity(monkeypatch):
+    """With ONE worker and a slowed round clock, request A opens a churn
+    group; B arrives mid-flight and can only be answered by boarding A's
+    in-flight group at a round boundary. Both answers are byte-identical
+    to the solo oracle, the join counter moves, the open-group registry
+    shows on /healthz while live, and B's record names its join round."""
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP_MIN_QLEN", "0")
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP_ROUND_DELAY_S", "0.2")
+    from abpoa_tpu.serve import AlignServer
+    abpt = _params(device="jax")
+    abpt.lockstep = "on"
+    srv = AlignServer(abpt, port=0, workers=1)
+    srv.start(warm="off")
+    results = {}
+    try:
+        assert srv._churn, "split-lockstep churn route was not planned"
+        base = f"http://{srv.host}:{srv.port}"
+        with open(TEST_FA, "rb") as fp:
+            body = fp.read()
+
+        def post(tag):
+            results[tag] = _post(base, body, timeout=120)
+
+        ta = threading.Thread(target=post, args=("a",))
+        ta.start()
+        time.sleep(0.3)   # A mid-flight: 4 reads x 0.2 s rounds
+        open_rungs = [g["rung"] for g in
+                      _get_json(base, "/healthz")[1].get("open_groups", [])]
+        tb = threading.Thread(target=post, args=("b",))
+        tb.start()
+        ta.join(120)
+        tb.join(120)
+        assert open_rungs, "no open group advertised while A was live"
+        import urllib.request
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            expo = r.read().decode()
+    finally:
+        srv.stop()
+    want = _oracle_bytes()
+    for tag in ("a", "b"):
+        st, got, _h = results[tag]
+        assert st == 200, (tag, got)
+        assert got == want, f"request {tag} diverged from the solo oracle"
+    from abpoa_tpu.obs import metrics as M
+    samples, _types = M.parse_exposition(expo)
+    assert (M.sample_value(samples, "abpoa_lockstep_joins_total")
+            or 0) >= 1, "B never boarded A's group"
+    occ = M.sample_value(samples, "abpoa_lockstep_lane_occupancy")
+    assert occ is not None and 0.0 < occ <= 1.0

@@ -322,6 +322,33 @@ def test_why_trace_attribution(tmp_path, capsys):
     assert "admission_wait" in out and "execute" in out
 
 
+def test_why_join_round_verdict():
+    """Continuous batching (PR 17): a churned request's verdict names the
+    group it boarded and the round it joined — replacing the stale
+    pickup-time coalesced-K clause."""
+    from abpoa_tpu.obs.why import verdict
+    rec = {"status": "ok", "total_wall_s": 1.2, "request_id": "aa",
+           "join_round": 4, "join_group": 7}
+    assert "joined group 7 at round 4" in verdict(rec, None, None)
+    # timeout: the join clause replaces "behind a coalesced K=N group"
+    rec = {"status": "timeout", "total_wall_s": 30.0, "deadline_s": 30.0,
+           "join_round": 2, "join_group": 3}
+    trace = {"traceEvents": [
+        {"name": "admission_wait", "cat": "serve", "ph": "X", "ts": 0.0,
+         "dur": 29e6, "pid": 1, "tid": 1,
+         "args": {"rid": "bb", "coalesced_k": 8}}]}
+    v = verdict(rec, trace, None)
+    assert "joined group 3 at round 2" in v and "K=8" not in v
+    # record missing the fields: the admission_wait span args carry them
+    trace2 = {"traceEvents": [
+        {"name": "admission_wait", "cat": "serve", "ph": "X", "ts": 0.0,
+         "dur": 29e6, "pid": 1, "tid": 1,
+         "args": {"rid": "cc", "coalesced_k": 2, "join_round": 5,
+                  "join_group": 1}}]}
+    rec = {"status": "timeout", "total_wall_s": 30.0, "deadline_s": 30.0}
+    assert "joined group 1 at round 5" in verdict(rec, trace2, None)
+
+
 # --------------------------------------------------------------------- #
 # satellites: slo offenders, loadgen ids, serve header + archive lint    #
 # --------------------------------------------------------------------- #
@@ -359,6 +386,34 @@ def test_loadgen_slowest_ids():
     s = gen.summary(1.0)
     assert [r["id"] for r in s["slowest"]] == ["bbb", "ccc", "aaa"]
     assert s["slowest"][0] == {"ms": 500.0, "status": "504", "id": "bbb"}
+
+
+def test_loadgen_churn_baseline_comparison():
+    """compare_ab: strict domination needs BOTH a lower p99 and a higher
+    goodput; ties or one-sided wins do not pass the churn gate."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    churn = {"ok": 20, "wall_s": 10.0, "latency_ms": {"p99": 800.0}}
+    static = {"ok": 15, "wall_s": 10.0, "latency_ms": {"p99": 1500.0}}
+    comp = loadgen.compare_ab(churn, static)
+    assert comp["dominates"]
+    assert comp["goodput_rps"] == {"churn": 2.0, "baseline": 1.5}
+    # p99 wins but goodput ties -> no domination
+    comp = loadgen.compare_ab(
+        {"ok": 15, "wall_s": 10.0, "latency_ms": {"p99": 800.0}}, static)
+    assert not comp["dominates"]
+    # goodput wins but p99 regresses -> no domination
+    comp = loadgen.compare_ab(
+        {"ok": 20, "wall_s": 10.0, "latency_ms": {"p99": 1600.0}}, static)
+    assert not comp["dominates"]
+    # missing percentile (no samples) -> conservative fail
+    comp = loadgen.compare_ab(
+        {"ok": 20, "wall_s": 10.0, "latency_ms": {"p99": None}}, static)
+    assert not comp["dominates"]
 
 
 def test_serve_request_id_header_and_trace(tmp_path, monkeypatch):

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Continuous-batching gate (CI: perf-gate job, beside lockstep_gate).
+
+PR 17's claim is operational, not kernel-level: under overload, letting
+requests JOIN in-flight lockstep rounds (and finished lanes retire early)
+must strictly dominate the static coalesce-at-pickup server. This gate
+runs the A/B at smoke scale on every host:
+
+- workload: same-rung sim sets at the bench read length (2 kb — above the
+  ~1.5 kb serial-wins crossover, so the serve scheduler actually routes
+  lockstep), small read counts so the whole gate stays in CI budget
+- calibrate: mean solo service time on a warm server -> capacity
+  (workers / mean); the timed runs arrive open-loop at 2x capacity —
+  the overload regime where continuous batching earns its keep
+- A: churn ON (ABPOA_TPU_SERVE_CHURN=1), B: churn OFF, IDENTICAL
+  open-loop schedule (tools/loadgen.py run_ab)
+- gate 1: churn p99 < static p99 AND churn goodput > static goodput
+  (loadgen's comparison.dominates)
+- gate 2: measured lane occupancy (per-round live/capacity, run MEAN —
+  the EWMA behind abpoa_lockstep_lane_occupancy is a recency gauge that
+  only sees the final group's drain) under churn EXCEEDS the static
+  run's — joins must actually backfill drained lanes
+- gate 3: zero transport errors on either side (admission answers, never
+  drops)
+
+Exits 0 on pass, 1 on a violation. --inject-slowdown F (test hook)
+multiplies the churn p99 and divides the churn goodput by F to prove
+the gate flips.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+# pin coalescing/joins to the quick-tier warm anchor's K rung (k=4 at the
+# 2 kb gate shape, halvings 2 and 1 covered by the repack warmer) — same
+# rung discipline as lockstep_gate, so after `warm_ladder("quick")` the
+# timed runs never pay an in-band XLA compile
+os.environ.setdefault("ABPOA_TPU_LOCKSTEP_K", "4")
+
+# divergent read counts on ONE rung (same ref length): static coalesced
+# groups idle the short set's lane while the long set drains — the
+# occupancy gap continuous batching exists to close
+READ_COUNTS, REF_LEN = (4, 8), 2000
+WORKERS = 2
+
+
+def _loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(HERE, "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _payloads():
+    out = []
+    for s, n_reads in enumerate(READ_COUNTS):
+        p = os.path.join("/tmp",
+                         f"churn_gate_{n_reads}x{REF_LEN}.{s}.fa")
+        if not os.path.isfile(p):
+            subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tests", "make_sim.py"),
+                 "--ref-len", str(REF_LEN), "--n-reads", str(n_reads),
+                 "--err", "0.1", "--seed", str(1700 + s), "--out", p],
+                check=True)
+        with open(p, "rb") as fp:
+            out.append(fp.read())
+    return out
+
+
+def _post(base: str, body: bytes, timeout: float = 600.0) -> float:
+    """One solo request; returns its service wall (calibration probe).
+    Carries a generous explicit deadline: the first posts against a fresh
+    server pay XLA compiles (or persistent-cache loads) that must not hit
+    the 30 s default SLA — only the TIMED loadgen runs measure that."""
+    req = urllib.request.Request(
+        base + "/align", data=body, method="POST",
+        headers={"X-Abpoa-Deadline-S": str(timeout)})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        r.read()
+        assert r.status == 200
+    return time.perf_counter() - t0
+
+
+def _serve(churn_on: bool):
+    """In-process server with the churn path toggled; returns (srv, url)."""
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.serve import AlignServer
+    os.environ["ABPOA_TPU_SERVE_CHURN"] = "1" if churn_on else "0"
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.lockstep = "on"
+    # loose server default: the warm/calibration posts must survive cold
+    # XLA compiles (X-Abpoa-Deadline-S can only TIGHTEN the server cap);
+    # the timed loadgen runs send the real 30 s SLA per request
+    srv = AlignServer(abpt, port=0, workers=WORKERS, deadline_s=600.0)
+    srv.start(warm="off")
+    return srv, f"http://{srv.host}:{srv.port}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    metavar="F", help="multiply churn p99 / divide churn "
+                    "goodput by F (test hook proving the gate flips)")
+    ap.add_argument("--n", type=int, default=16,
+                    help="requests per timed side [%(default)s]")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from abpoa_tpu import obs
+    from abpoa_tpu.compile.warm import warm_ladder
+    from abpoa_tpu.parallel import scheduler
+
+    loadgen = _loadgen()
+    payloads = _payloads()
+
+    # compile (or persistent-cache-load) every rung the timed runs touch
+    # BEFORE anything is measured: one cold 20 s+ XLA compile inside the
+    # timed window blows every queued request past its 30 s SLA on both
+    # sides. In CI the preceding `warm --ladder quick` step makes this a
+    # fast cache load.
+    t0 = time.perf_counter()
+    w = warm_ladder("quick")
+    print(f"[churn-gate] quick-ladder warm: {w['compiled']} compiled, "
+          f"{w['persistent_cache_hits']} cache loads, "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # ---- static baseline (churn OFF) --------------------------------- #
+    srv_b, url_b = _serve(churn_on=False)
+    assert srv_b._lockstep and not srv_b._churn, "static side mis-planned"
+    # warm pass: compiles (or persistent-cache loads from CI's preceding
+    # `warm --ladder quick` step) land before anything is timed
+    for body in payloads:
+        _post(url_b, body)
+    # calibrate capacity on the warm server: mean solo service
+    t_solo = [_post(url_b, payloads[i % len(payloads)]) for i in range(3)]
+    mean_s = sum(t_solo) / len(t_solo)
+    rate = 2.0 * WORKERS / mean_s   # open-loop 2x calibrated capacity
+    print(f"[churn-gate] solo service {mean_s:.2f}s -> "
+          f"2x-capacity rate {rate:.2f} req/s, n={args.n}/side",
+          file=sys.stderr)
+    scheduler.reset()
+    obs.start_run()
+    base_run = loadgen.LoadGen(url_b, payloads, rate, args.n,
+                               timeout_s=300.0, deadline_hdr=30.0).run()
+    static_occ = scheduler.occupancy_mean()
+    static_noop = scheduler.noop_ewma()
+    srv_b.stop()
+
+    # ---- continuous batching (churn ON) ------------------------------ #
+    srv_c, url_c = _serve(churn_on=True)
+    assert srv_c._churn, "churn side did not plan the split churn route"
+    for body in payloads:
+        _post(url_c, body)
+    scheduler.reset()
+    obs.start_run()
+    churn_run = loadgen.LoadGen(url_c, payloads, rate, args.n,
+                                timeout_s=300.0, deadline_hdr=30.0).run()
+    churn_occ = scheduler.occupancy_mean()
+    joins = obs.report().counters.get("lockstep.joins", 0)
+    retires = obs.report().counters.get("lockstep.early_retires", 0)
+    srv_c.stop()
+
+    if args.inject_slowdown:
+        f = args.inject_slowdown
+        lm = churn_run["latency_ms"]
+        lm["p99"] = (lm["p99"] or 0.0) * f
+        churn_run["wall_s"] = churn_run["wall_s"] * f
+        print(f"[churn-gate] injected {f}x churn slowdown (test hook)",
+              file=sys.stderr)
+
+    comp = loadgen.compare_ab(churn_run, base_run)
+    print(f"[churn-gate] p99 churn {comp['p99_ms']['churn']} ms vs static "
+          f"{comp['p99_ms']['baseline']} ms | goodput churn "
+          f"{comp['goodput_rps']['churn']} r/s vs static "
+          f"{comp['goodput_rps']['baseline']} r/s", file=sys.stderr)
+    print(f"[churn-gate] mean occupancy churn {churn_occ:.3f} vs static "
+          f"{static_occ:.3f} (static noop ewma {static_noop:.3f}) | "
+          f"joins {joins} early-retires {retires}", file=sys.stderr)
+
+    rc = 0
+    if not comp["dominates"]:
+        print("[churn-gate] FAIL: churn does not strictly dominate the "
+              "static baseline on p99 AND goodput at 2x capacity",
+              file=sys.stderr)
+        rc = 1
+    if not churn_occ > static_occ:
+        print(f"[churn-gate] FAIL: measured mean occupancy {churn_occ:.3f} "
+              f"does not exceed the static run's {static_occ:.3f} — joins "
+              "are not backfilling drained lanes", file=sys.stderr)
+        rc = 1
+    errors = churn_run["errors"] + base_run["errors"]
+    if errors:
+        print(f"[churn-gate] FAIL: {errors} transport errors — the "
+              "admission boundary dropped connections", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("[churn-gate] PASS", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -189,6 +189,41 @@ class LoadGen:
         return out
 
 
+def compare_ab(churn: dict, baseline: dict) -> dict:
+    """The churn-gate verdict: p99 AND goodput (200s per wall second) must
+    both strictly dominate the static baseline under the same open-loop
+    schedule."""
+
+    def goodput(s):
+        return round(s["ok"] / s["wall_s"], 3) if s.get("wall_s") else 0.0
+
+    c99 = (churn.get("latency_ms") or {}).get("p99")
+    b99 = (baseline.get("latency_ms") or {}).get("p99")
+    gc, gb = goodput(churn), goodput(baseline)
+    return {
+        "p99_ms": {"churn": c99, "baseline": b99},
+        "goodput_rps": {"churn": gc, "baseline": gb},
+        "dominates": bool(c99 is not None and b99 is not None
+                          and c99 < b99 and gc > gb),
+    }
+
+
+def run_ab(url_churn: str, url_baseline: str, payloads: List[bytes],
+           rate: float, n: int, timeout_s: float = 60.0,
+           deadline_hdr: Optional[float] = None,
+           max_inflight: int = 256) -> dict:
+    """--churn-baseline mode: the IDENTICAL open-loop schedule against the
+    churn server, then the static baseline, plus the comparison verdict."""
+    churn = LoadGen(url_churn, payloads, rate, n, timeout_s=timeout_s,
+                    max_inflight=max_inflight,
+                    deadline_hdr=deadline_hdr).run()
+    baseline = LoadGen(url_baseline, payloads, rate, n, timeout_s=timeout_s,
+                       max_inflight=max_inflight,
+                       deadline_hdr=deadline_hdr).run()
+    return {"churn": churn, "baseline": baseline,
+            "comparison": compare_ab(churn, baseline)}
+
+
 def run_sweep(url: str, payloads: List[bytes], rates: List[float],
               n_per_rate: int, timeout_s: float = 60.0,
               fleet: bool = False) -> List[dict]:
@@ -229,6 +264,12 @@ def main(argv=None) -> int:
                          "attribute every response to its replica "
                          "(X-Abpoa-Replica) and report the router's "
                          "failover/hedge counts in the summary")
+    ap.add_argument("--churn-baseline", type=str, default=None,
+                    metavar="URL2",
+                    help="A/B mode: after the --url run (churn server), "
+                         "replay the identical open-loop schedule against "
+                         "URL2 (static baseline); output is "
+                         "{churn, baseline, comparison}")
     ap.add_argument("--out", type=str, default=None, metavar="FILE",
                     help="write the JSON summary to FILE (stdout always "
                          "gets it too)")
@@ -237,7 +278,13 @@ def main(argv=None) -> int:
     for p in args.payload:
         with open(p, "rb") as fp:
             payloads.append(fp.read())
-    if args.sweep:
+    if args.churn_baseline:
+        result = run_ab(args.url, args.churn_baseline, payloads,
+                        args.rate, args.n, timeout_s=args.timeout_s,
+                        deadline_hdr=args.deadline_s,
+                        max_inflight=args.max_inflight)
+        worst = result["churn"]["errors"] + result["baseline"]["errors"]
+    elif args.sweep:
         rates = [float(r) for r in args.sweep.split(",")]
         result = run_sweep(args.url, payloads, rates, args.n,
                            timeout_s=args.timeout_s, fleet=args.fleet)
