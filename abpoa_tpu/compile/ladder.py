@@ -56,6 +56,16 @@ LADDER = {
     "run_dp_chunk": {
         "R": GEOM_64, "Qp": GEOM_128, "W": POW2_128, "P": POW2, "K": POW2,
     },
+    # map workload (PR 18): the SAME jitted entry as run_dp_chunk — a
+    # fixed restored graph pins R and P for the stream's lifetime, so a
+    # map deployment occupies exactly one (R, P) point of this grid per
+    # graph, times the Qp/W read rungs and the pow2 K read-batch axis.
+    # Declared separately so membership checks and the warm tiers can
+    # name the map shape; the registry still keys compiles under
+    # "run_dp_chunk" (one cache, shared with the consensus split driver).
+    "run_dp_chunk[map]": {
+        "R": GEOM_64, "Qp": GEOM_128, "W": POW2_128, "P": POW2, "K": POW2,
+    },
 }
 
 
@@ -154,6 +164,11 @@ QUICK_TIER: Tuple[WarmAnchor, ...] = (
     # the BENCH_lockstep_cpu K=4 row, same Qp rung as the 2200 fused
     # anchor above
     WarmAnchor("run_dp_chunk", qmax=2200, n_reads=20, growth=2, k=4),
+    # map workload at the gate shape: K=8 read batches (the default map
+    # K cap) against a static ~2 kb graph. Same jitted entry and R/Qp/W
+    # rungs as the k=4 anchor above, so only the K=8 signatures compile
+    # fresh — the 4/2/1 halvings are in-process cache hits.
+    WarmAnchor("run_dp_chunk", qmax=2200, n_reads=16, growth=2, k=8),
 )
 
 # full: quick + the north-star 10 kb consensus shape, the lockstep `-l`

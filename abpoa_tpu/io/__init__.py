@@ -1,1 +1,2 @@
 from .fastx import read_fastx, SeqRecord
+from .gaf import gaf_record, merged_cigar_str

@@ -261,8 +261,8 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         # rate-gauge origin: first render averages over registry lifetime,
         # later renders over the inter-render interval (live rates)
-        self._prev_rates: Tuple[float, float, float, float] = (
-            time.perf_counter(), 0.0, 0.0, 0.0)
+        self._prev_rates: Tuple[float, float, float, float, float] = (
+            time.perf_counter(), 0.0, 0.0, 0.0, 0.0)
         self.created = time.time()
 
     # ------------------------------------------------------------- families
@@ -302,14 +302,21 @@ class MetricsRegistry:
         reads = _fam_total(self, "abpoa_reads_total")
         cells = _fam_total(self, "abpoa_dp_cells_total")
         ops = _fam_total(self, "abpoa_dp_cell_ops_total")
+        map_reads = _fam_total(self, "abpoa_map_reads_total")
         prev = self._prev_rates
-        self._prev_rates = (now, reads, cells, ops)
+        self._prev_rates = (now, reads, cells, ops, map_reads)
         dt = now - prev[0]
         if dt <= 0:
             return
         g = self.gauge("abpoa_reads_per_second",
                        "Read throughput over the last exporter interval")
         g.set(round((reads - prev[1]) / dt, 3))
+        if map_reads > 0:
+            g = self.gauge("abpoa_map_reads_per_second",
+                           "Map-workload read throughput over the last "
+                           "exporter interval")
+            prev_map = prev[4] if len(prev) > 4 else 0.0
+            g.set(round((map_reads - prev_map) / dt, 3))
         g = self.gauge("abpoa_cell_updates_per_second",
                        "DP cell-updates/s over the last exporter interval "
                        "(the AnySeq/GPU throughput metric)")
@@ -479,6 +486,15 @@ _EXACT_FAMILIES = {
                           "crashed pool workers"),
     "serve.traces": ("abpoa_serve_traces_total",
                      "Per-request Chrome traces written to --trace-dir"),
+    # PR 18: fixed-graph map workload (parallel/map_driver.py)
+    "map.reads": ("abpoa_map_reads_total",
+                  "Reads mapped against a static graph (map workload)"),
+    "map.rounds": ("abpoa_map_rounds_total",
+                   "Map-driver dispatch rounds (one vmapped DP chunk per "
+                   "round, zero fusion barrier)"),
+    "map.joins": ("abpoa_map_joins_total",
+                  "Reads that boarded a map round via the streaming hook "
+                  "(continuous batching at DP-round granularity)"),
 }
 
 _BREAKER_PREFIXES = {
@@ -581,7 +597,7 @@ def set_breaker_state(backend: str, open_: bool) -> None:
             1 if open_ else 0, backend=backend)
 
 
-_ROUTE_KINDS = ("serial", "pool", "lockstep", "hybrid")
+_ROUTE_KINDS = ("serial", "pool", "lockstep", "hybrid", "map")
 
 
 def publish_noop_fraction(ewma: float) -> None:
@@ -603,6 +619,21 @@ def publish_lane_occupancy(ewma: float) -> None:
             "abpoa_lockstep_lane_occupancy",
             "EWMA of measured lockstep lane occupancy (live lanes over "
             "group capacity, per round)").set(ewma)
+
+
+def publish_map_round(reads: int, occ: float) -> None:
+    """One map-driver round: reads dispatched this round and the round's
+    lane occupancy (lanes over the K cap — every round boundary is a
+    join/retire point, so this gauge IS the map stream's fullness)."""
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge(
+        "abpoa_map_lane_occupancy",
+        "Lane occupancy of the last map-driver round (dispatched lanes "
+        "over the group's K cap)").set(occ)
+    _REGISTRY.gauge(
+        "abpoa_map_round_reads",
+        "Reads dispatched in the last map-driver round").set(reads)
 
 
 def publish_join_wait(wait_s: float) -> None:

@@ -80,10 +80,17 @@ def evaluate(objectives: dict, records: List[dict]) -> dict:
         name, metric = obj["name"], obj["metric"]
         ceiling = float(obj["max"])
         budget = float(obj.get("error_budget", 0.0))
+        # optional workload scope (PR 18): "map" runs amortize K-lane
+        # round walls into per-read shares, so they get their own
+        # ceilings; an objective without `workload` judges every run,
+        # records without the field count as "consensus"
+        scope = obj.get("workload")
         evaluated = bad = 0
         worst: Optional[float] = None
         offenders: List[tuple] = []   # (value, request id/label) of breaches
         for rec in records:
+            if scope and (rec.get("workload") or "consensus") != scope:
+                continue
             v = _metric(rec, metric)
             if v is None:
                 continue

@@ -150,6 +150,24 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
             lines.append("         join wait p50 %.0f ms  p99 %.0f ms"
                          % (1e3 * jq.get("0.5", 0), 1e3 * jq.get("0.99", 0)))
 
+    # map panel (present only when a map workload ran: `abpoa-tpu map`
+    # or serve --map-graph): pure-throughput reads against the static
+    # graph, plus the zero-barrier lane occupancy and join counters
+    map_reads = _total(samples, "abpoa_map_reads_total")
+    if map_reads:
+        mrps = M.sample_value(samples, "abpoa_map_reads_per_second") or 0.0
+        parts = [f"{_fmt_si(map_reads):>9} reads  {mrps:>9.1f}/s"]
+        rounds = _total(samples, "abpoa_map_rounds_total")
+        if rounds:
+            parts.append(f"rounds {rounds:.0f}")
+        joins = _total(samples, "abpoa_map_joins_total")
+        if joins:
+            parts.append(f"joins {joins:.0f}")
+        lines.append("map      " + "  ".join(parts))
+        mocc = M.sample_value(samples, "abpoa_map_lane_occupancy")
+        if mocc is not None:
+            lines.append(f"         occupancy {mocc:.2f} [{_bar(mocc, 8)}]")
+
     # process-pool panel (present only when a supervised worker pool ran:
     # -l --workers N or serve --pool-workers N)
     pool_up = M.sample_value(samples, "abpoa_pool_workers")

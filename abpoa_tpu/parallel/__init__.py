@@ -3,4 +3,6 @@ from .pool import (PoolJob, PoolWorkerError, WorkerPool, resolve_workers,
 from .runner import (flush_lockstep_group, flush_lockstep_group_churn,
                      lockstep_enabled, lockstep_group_size, run_batch,
                      run_lockstep_files, shard_dp_batch)
+from .map_driver import (MapHook, load_static_graph, map_read_host,
+                         map_reads_split)
 from .scheduler import Route, plan_route

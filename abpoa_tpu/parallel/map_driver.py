@@ -1,0 +1,272 @@
+"""Map driver: stream reads against ONE static graph — zero fusion barrier.
+
+The split consensus driver (parallel/lockstep.py) interleaves host fusion
+with each batched DP round because every lane's graph grows; a restored
+read-only graph deletes that tax entirely. This driver holds ONE cached
+`StaticGraphTables` (align/dp_chunk.py — graph half built once, query half
+stamped per read) and runs exactly one vmapped `run_dp_chunk` round per
+read batch:
+
+- every lane RETIRES at the end of every round (one read = one round, no
+  multi-round residency), so every round boundary is a join point — lane
+  occupancy under a saturated stream is limited only by arrival, not by
+  the consensus path's drain tails (the 0.844 PERF.md round 17 measured);
+- R and P are CONSTANT for the graph's lifetime (`StaticGraphTables.R`/
+  `.P`), so a warmed (R, Qp, W, K) signature serves the whole stream —
+  the map gate's zero-compile-miss claim;
+- results are per-read `(AlignResult, strand)` pairs (GAF material, io/
+  gaf.py), never consensus: the graph is NEVER mutated (asserted by the
+  restore→map→restore round-trip test);
+- amb-strand rescue is the same second batched dispatch as the consensus
+  driver: sub-threshold forward scores replay their reverse complement
+  against the SAME graph tables, best score wins, strand "-" records it;
+- a device backtrack divergence falls back to the per-read numpy oracle
+  (`fallback.map_bt_err`) instead of a sequential re-run of a whole set —
+  map lanes are single reads, so the fallback is one host alignment.
+
+Byte parity: per read this is the oracle's whole-graph global alignment
+(same tables, same band, same rc threshold), so GAF records are
+byte-identical to the host oracle for any K and any join schedule.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import constants as C
+from ..params import Params
+
+MAX_W_GROWTH = 6
+
+
+class MapHook:
+    """Round-boundary streaming protocol for `map_reads_split`.
+
+    ``on_round(round_i, free_slots)`` is called before each round and
+    returns up to ``free_slots`` joiners as ``(rid, query)`` tuples
+    (encoded np arrays). Off-rung joiners (qlen + 2 > Qp) are rejected via
+    ``on_retire(rid, None, round_i)`` — the hook owns answering them.
+
+    ``on_retire(rid, outcome, round_i)`` delivers one read's terminal
+    result the round it ran: ``(AlignResult, strand, fallback_reason)``
+    with strand "+"/"-" and fallback_reason None or "map_bt_err" (the
+    numpy-oracle rescue), or ``None`` for an off-rung rejection.
+    """
+
+    def on_round(self, round_i: int, free_slots: int) -> list:
+        return []
+
+    def on_retire(self, rid, outcome, round_i: int) -> None:  # pragma: no cover
+        pass
+
+
+def load_static_graph(path: str, abpt: Params):
+    """Restore a GFA/MSA graph from `path` (io/restore.py — same ingest as
+    `-i`) and wrap it in `StaticGraphTables`: THE setup step shared by
+    `abpoa-tpu map`, the serve `/map` registry and the gates. Returns
+    ``(ab, static)``; raises ValueError when the file restores nothing."""
+    from ..align.dp_chunk import StaticGraphTables
+    from ..io.restore import restore_graph
+    from ..pipeline import Abpoa
+    ab = Abpoa()
+    abpt.incr_fn = path
+    restore_graph(ab, abpt)
+    if ab.n_seq == 0 or ab.graph.node_n <= 2:
+        raise ValueError(f"no graph restored from {path!r} "
+                         "(expected abPOA GFA S/P lines or an MSA FASTA)")
+    return ab, StaticGraphTables(ab.graph, abpt)
+
+
+def map_read_host(g, abpt: Params, q: np.ndarray):
+    """Per-read host mapping — THE serial baseline and byte-parity oracle
+    (map_gate's A side, the CLI's no-accelerator route, the bt_err
+    fallback's contract): one whole-graph numpy alignment plus the same
+    amb-strand rc rescue rule as the batched driver. Returns
+    ``(AlignResult, strand)``."""
+    from ..align.oracle import align_sequence_to_subgraph_numpy
+    from ..pipeline import _rc_encode
+    res = align_sequence_to_subgraph_numpy(
+        g, abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, q)
+    strand = "+"
+    if abpt.amb_strand:
+        thr = min(len(q), g.node_n - 2) * abpt.max_mat * 0.3333
+        if res.best_score < thr:
+            rc = align_sequence_to_subgraph_numpy(
+                g, abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, _rc_encode(q))
+            if rc.best_score > res.best_score:
+                res, strand = rc, "-"
+    return res, strand
+
+
+def _stamp_rc(tables: dict, abpt: Params, rc_q: np.ndarray) -> dict:
+    """Re-stamp one lane's table dict with the reverse complement (copy —
+    the shared graph arrays stay untouched)."""
+    t = dict(tables)
+    qp = np.zeros_like(t["qp"])
+    query_pad = np.zeros_like(t["query"])
+    if len(rc_q):
+        qp[:, 1: len(rc_q) + 1] = abpt.mat[:, rc_q]
+        query_pad[:len(rc_q)] = rc_q
+    t["qp"] = qp
+    t["query"] = query_pad
+    return t
+
+
+def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
+                    k_cap: Optional[int] = None,
+                    hook: Optional[MapHook] = None,
+                    Qp: Optional[int] = None) -> list:
+    """Map `queries` (plus any hook-streamed joiners) against the static
+    graph in vmapped pow2 read batches of up to `k_cap` lanes.
+
+    Returns one ``(AlignResult, strand, fallback_reason)`` triple per
+    initial query, in order. Hook joiners are answered exclusively through
+    ``hook.on_retire``. `Qp` pins the group's query rung (serve groups);
+    by default it is planned from the longest initial query.
+    """
+    from .. import obs
+    from ..align.dp_chunk import (chunk_plane16, dispatch_dp_chunk,
+                                  result_from_chunk)
+    from ..align.oracle import align_sequence_to_subgraph_numpy
+    from ..compile.ladder import k_rung, plan_chunk_buckets, qp_rung
+    from ..obs import metrics
+    from ..pipeline import _band_cols, _rc_encode
+    from . import scheduler
+
+    if Qp is None:
+        qmax0 = max((len(q) for q in queries), default=1)
+        Qp = qp_rung(qmax0)
+    _qp, W, _local = plan_chunk_buckets(abpt, Qp - 2)
+    if k_cap is None:
+        from .runner import lockstep_group_size
+        k_cap = scheduler.noop_k_cap(lockstep_group_size())
+    k_cap = max(1, int(k_cap))
+    amb = bool(abpt.amb_strand)
+    g = static.graph
+    R, P = static.R, static.P
+    plane16 = chunk_plane16(abpt, Qp - 2, static.n_rows)
+    thr_base = abpt.max_mat * 0.3333
+
+    # pending initial reads feed lanes exactly like hook joiners: the
+    # driver is one stream, arrival order preserved
+    pending: List[Tuple[int, np.ndarray]] = list(enumerate(queries))
+    final: dict = {}
+
+    def retire(rid, outcome, round_i: int) -> None:
+        if isinstance(rid, int) and 0 <= rid < len(queries):
+            final[rid] = outcome
+        if hook is not None:
+            hook.on_retire(rid, outcome, round_i)
+
+    round_i = 0
+    while True:
+        # board: pending initial reads first, then hook joiners into the
+        # remaining free slots — every slot is free every round (zero
+        # fusion barrier: no lane survives a round)
+        lanes: List[Tuple[object, np.ndarray]] = []
+        while pending and len(lanes) < k_cap:
+            rid, q = pending.pop(0)
+            if len(q) + 2 > Qp:
+                # oversized initial read: same off-rung contract as a
+                # joiner — reject, never force a new Qp rung
+                retire(rid, None, round_i + 1)
+                continue
+            lanes.append((rid, q))
+        if hook is not None:
+            joiners = hook.on_round(round_i + 1, k_cap - len(lanes))
+            for rid, q in joiners or ():
+                if len(q) + 2 > Qp or len(lanes) >= k_cap:
+                    retire(rid, None, round_i + 1)
+                    continue
+                lanes.append((rid, q))
+                obs.count("map.joins")
+        if not lanes:
+            break
+        round_i += 1
+        t_round = time.perf_counter()
+        obs.count("map.rounds")
+        occ = len(lanes) / k_cap
+        scheduler.observe_lane_occupancy(occ)
+        metrics.publish_map_round(len(lanes), occ)
+
+        with obs.phase("align"):
+            tables = []
+            for _rid, q in lanes:
+                obs.record_dp(static.n_rows, _band_cols(abpt, len(q)),
+                              abpt.gap_mode)
+                tables.append(static.tables_for(q, Qp))
+            Kb = k_rung(len(lanes))
+            # W-growth retry wraps BOTH strand dispatches, same contract
+            # as the consensus driver: an overflowed result never escapes
+            results: list = []
+            for _g in range(MAX_W_GROWTH + 1):
+                packed = dispatch_dp_chunk(abpt, tables, Kb, R, P, Qp, W,
+                                           plane16)
+                results = [
+                    result_from_chunk(abpt, packed[i], tables[i],
+                                      static.idx2nid) + ("+",)
+                    for i in range(len(lanes))]
+                overflowed = any(f["overflow"] for _res, f, _s in results)
+                if amb and not overflowed:
+                    rc_is = []
+                    for i, (_rid, q) in enumerate(lanes):
+                        res, _f, _s = results[i]
+                        thr = min(len(q), g.node_n - 2) * thr_base
+                        if res.best_score < thr:
+                            rc_is.append(i)
+                    if rc_is:
+                        rc_tables = []
+                        for i in rc_is:
+                            rc_q = _rc_encode(lanes[i][1])
+                            obs.record_dp(static.n_rows,
+                                          _band_cols(abpt, len(rc_q)),
+                                          abpt.gap_mode)
+                            rc_tables.append(_stamp_rc(tables[i], abpt,
+                                                       rc_q))
+                        rc_packed = dispatch_dp_chunk(abpt, rc_tables, Kb,
+                                                      R, P, Qp, W, plane16)
+                        for j, i in enumerate(rc_is):
+                            rc_res, rc_f = result_from_chunk(
+                                abpt, rc_packed[j], rc_tables[j],
+                                static.idx2nid)
+                            if rc_f["overflow"]:
+                                overflowed = True
+                            elif rc_f["bt_err"]:
+                                results[i] = (results[i][0],
+                                              {"overflow": False,
+                                               "bt_err": True}, "+")
+                            elif (rc_res.best_score
+                                  > results[i][0].best_score):
+                                results[i] = (rc_res, rc_f, "-")
+                if not overflowed:
+                    break
+                W *= 2
+                obs.count("fused.grow.band")
+            else:
+                raise RuntimeError(
+                    "map driver: band growth did not converge")
+
+        n_done = 0
+        for i, (rid, q) in enumerate(lanes):
+            res, f, strand = results[i]
+            fallback = None
+            if f["bt_err"]:
+                # single-read lane: the numpy oracle IS the sequential
+                # re-run — one host alignment, counted as a fallback
+                obs.count("fallback.map_bt_err")
+                fallback = "map_bt_err"
+                oq = _rc_encode(q) if strand == "-" else q
+                res = align_sequence_to_subgraph_numpy(
+                    g, abpt, C.SRC_NODE_ID, C.SINK_NODE_ID, oq)
+            retire(rid, (res, strand, fallback), round_i)
+            n_done += 1
+        obs.count("map.reads", n_done)
+        share = (time.perf_counter() - t_round) / max(n_done, 1)
+        for _rid, q in lanes:
+            obs.record_read(share, len(q), _band_cols(abpt, len(q)),
+                            abpt.device, amortized=True,
+                            fallback=None)
+
+    return [final.get(rid) for rid in range(len(queries))]

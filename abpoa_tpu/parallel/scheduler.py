@@ -35,9 +35,9 @@ from typing import NamedTuple, Optional
 
 
 class Route(NamedTuple):
-    kind: str       # "serial" | "pool" | "lockstep" | "hybrid"
+    kind: str       # "serial" | "pool" | "lockstep" | "hybrid" | "map"
     impl: str       # lockstep implementation: "split" | "device" | ""
-    k_cap: int      # sets per lockstep group (lockstep/hybrid)
+    k_cap: int      # sets per lockstep group (lockstep/hybrid/map)
     workers: int    # worker processes (pool/hybrid)
     reason: str
 
@@ -160,7 +160,8 @@ def lockstep_impl(abpt) -> str:
 
 
 def plan_route(abpt, n_sets: int, serve: bool = False,
-               qlen: Optional[int] = None) -> Route:
+               qlen: Optional[int] = None,
+               workload: str = "consensus") -> Route:
     """THE batch/serve dispatch decision: device inventory (accelerator vs
     CPU, core count via pool.resolve_workers), lockstep eligibility
     (config scope + opt-in), and the noop-fraction K cap, in one place.
@@ -172,10 +173,19 @@ def plan_route(abpt, n_sets: int, serve: bool = False,
     ~1.5 kb crossover (lockstep_min_qlen) the per-round fusion + dispatch
     overhead loses to serial even with lockstep enabled, so such sets
     route serial/pool rather than occupying a lockstep group.
+
+    workload="map" plans the fixed-graph map route instead: there is no
+    per-round host fusion to amortize, so neither the 1.5 kb qlen
+    crossover nor `_lockstep_ok`'s no-incremental-graph clause applies
+    (map BY DEFINITION restores via abpt.incr_fn). The K cap still rides
+    the measured-occupancy feedback.
     """
     from .runner import _lockstep_ok, lockstep_group_size
-    route = _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
-                  qlen)
+    if workload == "map":
+        route = _plan_map(abpt, n_sets, lockstep_group_size)
+    else:
+        route = _plan(abpt, n_sets, serve, _lockstep_ok,
+                      lockstep_group_size, qlen)
     from ..obs import count, metrics, trace
     count(f"scheduler.{route.kind}")
     metrics.publish_route(route)
@@ -183,6 +193,24 @@ def plan_route(abpt, n_sets: int, serve: bool = False,
     # group ran serial-fallback (or K-capped) can show why in its tree
     trace.instant("route", "sched", args=route._asdict())
     return route
+
+
+def _plan_map(abpt, n_reads, lockstep_group_size) -> Route:
+    """The map workload's route: batched split-DP rounds whenever a
+    jax-family backend is present (the map driver IS the split dispatch
+    minus fusion), serial per-read host alignment otherwise. No qlen
+    crossover — a short read costs one round like a long one."""
+    if n_reads <= 0:
+        return Route("serial", "", 1, 1, "empty read stream")
+    if abpt.device not in ("jax", "tpu", "pallas"):
+        return Route("serial", "", 1, 1,
+                     f"device {abpt.device!r} has no batched DP chunk")
+    base_k = lockstep_group_size()
+    k_cap = noop_k_cap(base_k)
+    reason = f"map split k_cap={k_cap}"
+    if k_cap != base_k:
+        reason += f" (noop ewma {_NOOP['ewma']:.2f} capped {base_k})"
+    return Route("map", "split", k_cap, 1, reason)
 
 
 def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,

@@ -75,6 +75,25 @@ def request_caps(abpt, records) -> dict:
                 gap_mode=abpt.gap_mode, m=abpt.m)
 
 
+def map_request_bytes(abpt, records, n_rows: int) -> int:
+    """Admission price for ONE /map request: per-read bytes ONLY. The
+    graph half of the map tables (adjacency scatter, base rows) is
+    immutable and shared by every lane for the server's lifetime — it was
+    priced once when the graph was restored — so a map request pays just
+    its reads' share of the run_dp_chunk dispatch: the banded DP planes
+    over the graph's row rung plus each read's qp profile. jax-free, same
+    contract as `request_caps`."""
+    from ..compile.buckets import bucket
+    from ..compile.ladder import plan_chunk_buckets
+    qmax = max((len(r.seq) for r in records), default=1)
+    Qp, W, _local = plan_chunk_buckets(abpt, qmax)
+    R = bucket(max(n_rows, 8), 64)
+    planes = memory._N_PLANES.get(abpt.gap_mode, 6)
+    per_read = (planes * R * min(W, Qp + 1) * 4   # banded DP planes
+                + Qp * (8 + 4 * abpt.m))          # query + qp profile
+    return len(records) * per_read
+
+
 class Job:
     """One admitted alignment request moving through the queue."""
 
@@ -84,11 +103,12 @@ class Job:
                  "eligible", "deadline_s", "t_arrive", "done", "status",
                  "body", "error", "_lock", "_done_marked",
                  "rid", "t_pickup", "dumps", "attempt", "qmax",
-                 "join_round", "join_group")
+                 "join_round", "join_group", "kind")
 
     def __init__(self, records, rung: int, est_bytes: int, eligible: bool,
                  deadline_s: float, rid: str = "",
-                 attempt: int = 1, qmax: int = 0) -> None:
+                 attempt: int = 1, qmax: int = 0,
+                 kind: str = "consensus") -> None:
         self.id = next(self._ids)
         self.label = f"req-{self.id}"
         # the request id minted at ingress (PR 15): rides the response
@@ -110,6 +130,12 @@ class Job:
         # coalesced at pickup — `why` renders "joined group g at round r"
         self.join_round: Optional[int] = None
         self.join_group: Optional[int] = None
+        # workload class (PR 18): "consensus" (POST /align) or "map"
+        # (POST /map — fixed-graph read mapping). Groups are kind-
+        # homogeneous: a map lane retires every round while a consensus
+        # lane drains for many, so mixing them would re-create exactly
+        # the divergence the noop K cap exists to suppress.
+        self.kind = kind
         self.records = records
         self.n_reads = len(records)
         self.rung = rung
@@ -213,13 +239,18 @@ class AdmissionController:
                     return []
             head = self._queue.popleft()
             group = [head]
-            if coalesce and head.qmax and head.qmax < min_qlen:
+            # the serial-wins qlen crossover is a consensus-path economy
+            # (per-round host fusion to amortize); a map round has no
+            # fusion, so short map reads still batch
+            if (coalesce and head.kind != "map"
+                    and head.qmax and head.qmax < min_qlen):
                 coalesce = False
             if coalesce and head.eligible and max_k > 1:
                 for job in list(self._queue):
                     if len(group) >= max_k:
                         break
-                    if job.eligible and job.rung == head.rung:
+                    if (job.eligible and job.rung == head.rung
+                            and job.kind == head.kind):
                         self._queue.remove(job)
                         group.append(job)
             self._inflight += len(group)
@@ -234,7 +265,8 @@ class AdmissionController:
 
     def claim_joiners(self, rung: int, max_n: int,
                       live_bytes: int = 0,
-                      min_remaining_s: float = 0.5) -> List[Job]:
+                      min_remaining_s: float = 0.5,
+                      kind: str = "consensus") -> List[Job]:
         """Continuous batching (PR 17): pull up to max_n queued jobs onto
         the free lanes of an in-flight lockstep group at its round
         boundary. A joiner must share the group's Qp rung, be lockstep-
@@ -253,7 +285,8 @@ class AdmissionController:
             for job in list(self._queue):
                 if len(claimed) >= max_n:
                     break
-                if not job.eligible or job.rung != rung:
+                if (not job.eligible or job.rung != rung
+                        or job.kind != kind):
                     continue
                 if job.remaining_s() <= min_remaining_s:
                     continue

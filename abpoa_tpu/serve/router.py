@@ -113,20 +113,26 @@ class ReplicaView:
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "local_inflight": self.local_inflight}
 
-    def open_group_rungs(self) -> set:
-        """Rungs with a boardable in-flight lockstep group on this replica
-        (free lane + continuous batching on), from the last scraped
-        /healthz `open_groups` block. A request placed here joins at the
-        group's next round boundary instead of waiting out a fresh one."""
+    def open_group_rungs(self, kind: str = "consensus") -> set:
+        """Rungs with a boardable in-flight group of this KIND on this
+        replica (free lane + continuous batching on), from the last
+        scraped /healthz `open_groups` block. A request placed here joins
+        at the group's next round boundary instead of waiting out a fresh
+        one. Groups are kind-homogeneous (PR 18): a /map request can only
+        board a map group, so affinity filters on the advertised kind
+        (absent = consensus, pre-PR-18 replicas)."""
         try:
             return {int(g["rung"]) for g in self.health.get(
-                "open_groups") or () if int(g.get("free") or 0) > 0}
+                "open_groups") or ()
+                if int(g.get("free") or 0) > 0
+                and str(g.get("kind") or "consensus") == kind}
         except (TypeError, ValueError, KeyError):
             return set()
 
 
 def plan_placement(views: List[ReplicaView],
-                   rung: Optional[int] = None) -> List[ReplicaView]:
+                   rung: Optional[int] = None,
+                   kind: str = "consensus") -> List[ReplicaView]:
     """Candidate order for one request: ready, non-draining replicas by
     ascending observed load (scraped queue depth + inflight + the
     router's own unanswered sends), rung affinity breaking ties.
@@ -136,13 +142,15 @@ def plan_placement(views: List[ReplicaView],
     outranks one that merely served this rung last (warm compile cache),
     which outranks the rest — a request placed on tier 0 boards an
     in-flight group at its next round boundary. Load still dominates:
-    affinity never outranks a shorter queue."""
+    affinity never outranks a shorter queue. Tier 0 only matches groups
+    of the request's KIND (map vs consensus, PR 18): seating a /map
+    request behind a consensus group's drain would be anti-affinity."""
     ready = [v for v in views if v.ready and not v.draining]
 
     def key(v: ReplicaView):
         if rung is None:
             affinity = 2
-        elif rung in v.open_group_rungs():
+        elif rung in v.open_group_rungs(kind):
             affinity = 0
         elif v.last_rung == rung:
             affinity = 1
@@ -346,9 +354,10 @@ class FleetRouter:
     # ------------------------------------------------------------ routing
     def _post_replica(self, v: ReplicaView, body: bytes,
                       fwd: Dict[str, str], rid: str,
-                      attempt: int) -> Tuple[str, int, bytes, Dict]:
+                      attempt: int,
+                      path: str = "/align") -> Tuple[str, int, bytes, Dict]:
         req = urllib.request.Request(
-            v.base_url + "/align", data=body, method="POST",
+            v.base_url + path, data=body, method="POST",
             headers={**fwd, "X-Abpoa-Request-Id": rid,
                      "X-Abpoa-Attempt": str(attempt)})
         try:
@@ -363,12 +372,16 @@ class FleetRouter:
             # replica never delivered a status line: failover material
             return ("transport", 0, b"", {"error": str(e)})
 
-    def route(self, body: bytes, fwd: Dict[str, str], rid: str) -> _Outcome:
+    def route(self, body: bytes, fwd: Dict[str, str], rid: str,
+              path: str = "/align") -> _Outcome:
         """Race one request to a terminal answer across the fleet. The
         winner is the first non-shed HTTP response; transport errors
         trigger the exactly-once failover, sheds spill to untried
-        siblings, and one bounded hedge covers stragglers."""
+        siblings, and one bounded hedge covers stragglers. `path` is the
+        inbound endpoint, forwarded verbatim (/align or /map); /map
+        placement only gets tier-0 affinity from open MAP groups."""
         t0 = time.perf_counter()
+        kind = "map" if path == "/map" else "consensus"
         rung = _body_rung(body)
         resq: "queue.Queue" = queue.Queue()
         outstanding = 0
@@ -378,7 +391,7 @@ class FleetRouter:
         shed: List[Tuple[int, bytes, Dict]] = []
         lost_transport = 0
 
-        def launch(v: ReplicaView, attempt_no: int, kind: str) -> None:
+        def launch(v: ReplicaView, attempt_no: int, label: str) -> None:
             nonlocal outstanding, attempts
             outstanding += 1
             attempts = max(attempts, attempt_no)
@@ -387,21 +400,22 @@ class FleetRouter:
                 v.local_inflight += 1
 
             def run():
-                res = self._post_replica(v, body, fwd, rid, attempt_no)
+                res = self._post_replica(v, body, fwd, rid, attempt_no,
+                                         path)
                 with self._lock:
                     v.local_inflight = max(0, v.local_inflight - 1)
-                resq.put((v, attempt_no, kind, res))
+                resq.put((v, attempt_no, label, res))
 
             threading.Thread(target=run, daemon=True,
-                             name=f"abpoa-fleet-{kind}").start()
+                             name=f"abpoa-fleet-{label}").start()
 
         def next_candidate() -> Optional[ReplicaView]:
-            for v in plan_placement(self.views(), rung):
+            for v in plan_placement(self.views(), rung, kind):
                 if v.name not in tried:
                     return v
             return None
 
-        first = plan_placement(self.views(), rung)
+        first = plan_placement(self.views(), rung, kind)
         if not first:
             return _Outcome(503, b"", {"Retry-After": "5"},
                             failovers=0, hedges=0)
@@ -425,7 +439,7 @@ class FleetRouter:
                     continue
                 timeout = remaining
             try:
-                v, attempt_no, kind, (tk, code, rbody, rheaders) = \
+                v, attempt_no, label, (tk, code, rbody, rheaders) = \
                     resq.get(timeout=timeout)
             except queue.Empty:
                 continue
@@ -438,7 +452,8 @@ class FleetRouter:
                         # nowhere untried left — a sibling that only shed
                         # may still accept the retry
                         ready = [w for w in
-                                 plan_placement(self.views(), rung)
+                                 plan_placement(self.views(), rung,
+                                                kind)
                                  if w.name != v.name]
                         cand = ready[0] if ready else None
                     if cand is not None:
@@ -463,13 +478,13 @@ class FleetRouter:
             # terminal answer: first writer wins; outstanding duplicates
             # drain in their daemon threads and are discarded
             self.sketch.observe(time.perf_counter() - t0)
-            if kind == "hedge":
+            if label == "hedge":
                 self._c_hedge_wins.inc()
             replica = rheaders.get("X-Abpoa-Replica") or v.name
             v.last_rung = rung
             return _Outcome(code, rbody, rheaders, replica=replica,
                             attempt=attempt_no, failovers=failovers,
-                            hedges=hedges, hedge_won=(kind == "hedge"))
+                            hedges=hedges, hedge_won=(label == "hedge"))
         # no replica produced a terminal answer
         if shed:
             code, rbody, rheaders = shed[-1]
@@ -540,7 +555,8 @@ def _make_router_handler(router: FleetRouter):
 
         # -------------------------------------------------------- POST
         def do_POST(self):  # noqa: N802 — http.server API
-            if self.path.rstrip("/") != "/align":
+            path = self.path.rstrip("/")
+            if path not in ("/align", "/map"):
                 self._json(404, {"error": f"unknown path {self.path!r}"})
                 return
             # ingress id, minted here so every delivery attempt across
@@ -575,7 +591,7 @@ def _make_router_handler(router: FleetRouter):
             raw = self.rfile.read(n) if n else b""
             fwd = {k: self.headers[k] for k in _FWD_REQUEST
                    if self.headers.get(k)}
-            out = router.route(raw, fwd, rid)
+            out = router.route(raw, fwd, rid, path)
             status_key = {200: "ok", 429: "shed", 503: "shed",
                           400: "poisoned", 504: "timeout"}.get(
                 out.code, "error" if out.code >= 500 else "other")

@@ -10,6 +10,11 @@ Endpoints (stdlib http.server, one ThreadingHTTPServer):
                     header), 503 draining, 504 deadline expired.
                     ``X-Abpoa-Deadline-S`` caps this request tighter
                     than the server default.
+- ``POST /map``     (with ``--map-graph``) body = FASTA/FASTQ reads,
+                    response = one GAF-style record per read mapped
+                    against the fixed restored graph (PR 18). Same
+                    status-code contract as /align, plus 400 for a read
+                    over the map length cap; the graph is never mutated.
 - ``GET /healthz``  liveness + the degradation story: 200 always while
                     the process lives, JSON body with status
                     ok|degraded|draining, open breakers, queue depth,
@@ -83,6 +88,13 @@ def _test_delay_s() -> float:
     return float(os.environ.get("ABPOA_TPU_SERVE_DELAY_S", "0"))
 
 
+def map_max_qlen() -> int:
+    """Longest read POST /map accepts (400 past it): bounds the Qp rungs
+    a map deployment can be asked to serve, so the warmed signature set
+    stays finite. ABPOA_TPU_MAP_MAX_QLEN overrides."""
+    return int(os.environ.get("ABPOA_TPU_MAP_MAX_QLEN", "100000"))
+
+
 def replica_name() -> Optional[str]:
     """This process's fleet replica name (ABPOA_TPU_REPLICA, set by the
     fleet supervisor at spawn). None outside a fleet."""
@@ -142,6 +154,10 @@ def _request_record(job: Job, status: str, device: str) -> dict:
     if rep:
         rec["replica"] = rep
     rec["attempt"] = job.attempt
+    if job.kind == "map":
+        # /map requests archive the same record shape (slo/why read them
+        # verbatim); the workload tag lets a window be split by kind
+        rec["workload"] = "map"
     if job.join_round is not None:
         # continuous batching: this request boarded an in-flight lockstep
         # group at a round boundary — `why` names the round it boarded
@@ -281,6 +297,145 @@ class _ServeChurnHook:
             server.admission.mark_done(job, service)
 
 
+class _ServeMapHook:
+    """Round-boundary streaming driver for ONE serve map group
+    (parallel/map_driver.MapHook protocol). A map lane is a single READ,
+    not a request: each /map request's reads queue onto lanes, every lane
+    retires every round (zero fusion barrier — every boundary is a join
+    point), and a request is answered the round its LAST read retires.
+    Between rounds the hook claims queued same-rung map requests
+    (admission.claim_joiners kind="map") so the group keeps serving as
+    long as compatible reads keep arriving."""
+
+    def __init__(self, server: "AlignServer", abpt: Params, gid: int,
+                 rung: int, k_cap: int) -> None:
+        from collections import deque
+        self.server = server
+        self.abpt = abpt
+        self.gid = gid
+        self.rung = rung
+        self.k_cap = max(1, k_cap)
+        self.states: Dict[int, dict] = {}   # job.id -> per-request state
+        self.lane_q = deque()               # (job_id, read_idx) to board
+        self.closed = False
+
+    def add_job(self, job: Job) -> None:
+        import numpy as np
+        encode = self.abpt.char_to_code
+        queries = [
+            encode[np.frombuffer(r.seq.encode(), dtype=np.uint8)
+                   ].astype(np.uint8)
+            for r in job.records]
+        self.states[job.id] = {"job": job, "queries": queries,
+                               "results": [None] * len(queries),
+                               "left": len(queries)}
+        for idx in range(len(queries)):
+            self.lane_q.append((job.id, idx))
+
+    def live_bytes(self) -> int:
+        return sum(st["job"].est_bytes for st in self.states.values())
+
+    def _expire(self, job: Job) -> None:
+        server = self.server
+        obs.record_fault("request_timeout", detail=job.label,
+                         action="evicted_at_round",
+                         extra={"request_id": job.rid} if job.rid else None)
+        if job.finish("timeout", error="request deadline expired "
+                                       "(map reads still queued)"):
+            server.account(job, "timeout")
+        server.admission.mark_done(job)
+
+    def _fill(self, out: list, free_slots: int) -> None:
+        while self.lane_q and len(out) < free_slots:
+            jid, idx = self.lane_q.popleft()
+            st = self.states.get(jid)
+            if st is None:
+                continue
+            job = st["job"]
+            if job.remaining_s() <= 0:
+                # boundary 504: drop the whole request — its other queued
+                # reads are dead work (lanes already in flight this round
+                # still retire into a finished job, harmlessly)
+                self.states.pop(jid, None)
+                self._expire(job)
+                continue
+            out.append(((jid, idx), st["queries"][idx]))
+
+    # -------------------------------------------------- MapHook protocol
+    def on_round(self, round_i: int, free_slots: int) -> list:
+        from ..obs import metrics
+        server = self.server
+        out: list = []
+        self._fill(out, free_slots)
+        free = free_slots - len(out)
+        if free > 0 and not self.closed and not server.admission.closed:
+            claimed = server.admission.claim_joiners(
+                self.rung, free, live_bytes=self.live_bytes(), kind="map")
+            for job in claimed:
+                job.join_round = round_i
+                job.join_group = self.gid
+                self.add_job(job)
+                wait = max(0.0, (job.t_pickup or time.perf_counter())
+                           - job.t_arrive)
+                metrics.publish_join_wait(wait)
+                if obs.trace_enabled():
+                    obs.trace.add_span(
+                        "admission_wait", "serve", job.t_arrive, wait,
+                        args={"rung": job.rung, "join_round": round_i,
+                              "join_group": self.gid, "kind": "map"},
+                        req=(job.rid, 0) if job.rid else None)
+            self._fill(out, free_slots)
+        server._open_group_update(
+            self.gid, self.rung, free_slots - len(out), round_i, len(out),
+            kind="map")
+        return out
+
+    def on_retire(self, rid, outcome, round_i: int) -> None:
+        jid, idx = rid
+        st = self.states.get(jid)
+        if st is None:
+            return
+        st["results"][idx] = outcome  # None = off-rung (host sweep below)
+        st["left"] -= 1
+        if st["left"] > 0:
+            return
+        self.states.pop(jid, None)
+        self._answer(st)
+
+    def _answer(self, st: dict) -> None:
+        from ..io import gaf_record
+        from ..parallel import map_read_host
+        server = self.server
+        job = st["job"]
+        static = server._map_static
+        service = max(0.0, time.perf_counter()
+                      - (job.t_pickup or job.t_arrive))
+        try:
+            lines = []
+            for rec, q, outcome in zip(job.records, st["queries"],
+                                       st["results"]):
+                if outcome is None:
+                    # off-rung lane reject (can't normally happen: the
+                    # request's rung bounds every read) — host alignment
+                    # keeps the answer complete
+                    res, strand = map_read_host(static.graph, self.abpt, q)
+                    fallback = "map_off_rung"
+                else:
+                    res, strand, fallback = outcome
+                lines.append(gaf_record(rec.name, q, res,
+                                        static.base_by_nid, strand,
+                                        comment=rec.comment or None))
+            if job.finish("ok", body="".join(ln + "\n" for ln in lines)):
+                server.account(job, "ok")
+        except Exception as e:  # noqa: BLE001 — group must survive
+            obs.record_fault("request_error", detail=str(e)[:300],
+                             action="rejected_500")
+            if job.finish("error", error=f"{type(e).__name__}: {e}"):
+                server.account(job, "error")
+        finally:
+            server.admission.mark_done(job, service)
+
+
 class AlignServer:
     """Owns the admission queue, the worker pool and the HTTP front.
     `start()` binds + warms + marks ready; `begin_drain()`/`drain()` is
@@ -290,7 +445,8 @@ class AlignServer:
                  workers: int = 2, queue_depth: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  pool_workers: Optional[int] = None,
-                 trace_dir: Optional[str] = None) -> None:
+                 trace_dir: Optional[str] = None,
+                 map_graph: Optional[str] = None) -> None:
         if not abpt._finalized:
             abpt = abpt.finalize()
         self.abpt = abpt
@@ -327,6 +483,12 @@ class AlignServer:
         # registry backs /healthz's `open_groups` block (fleet routers
         # prefer replicas with a boardable group on the request's rung).
         self._churn = False
+        # map workload (PR 18): a fixed graph restored ONCE at startup
+        # (--map-graph), wrapped in StaticGraphTables, served by POST /map
+        self._map_graph = map_graph or os.environ.get(
+            "ABPOA_TPU_SERVE_MAP_GRAPH") or None
+        self._map_static = None
+        self._map_coalesce = False   # batched map groups (split driver)
         self._open_groups: Dict[int, dict] = {}
         self._open_lock = threading.Lock()
         import itertools
@@ -397,6 +559,20 @@ class AlignServer:
             else:
                 print("[abpoa-tpu serve] Warning: JAX backend probe timed "
                       "out; serving on the host engine.", file=sys.stderr)
+        if self._map_graph:
+            # restore the map graph ONCE — every /map request maps
+            # against these immutable tables; the restore (not the
+            # requests) pays the graph-plane price
+            from ..parallel import load_static_graph, plan_route
+            t0 = time.perf_counter()
+            _ab, self._map_static = load_static_graph(self._map_graph,
+                                                      self.abpt)
+            route = plan_route(self.abpt, 1, workload="map")
+            self._map_coalesce = route.kind == "map"
+            print(f"[abpoa-tpu serve] map graph {self._map_graph}: "
+                  f"{self._map_static.n_rows - 2} nodes restored in "
+                  f"{time.perf_counter() - t0:.1f}s "
+                  f"(route {route.kind}: {route.reason})", file=sys.stderr)
         if self._pool_n:
             # spawned AFTER the warm so fresh workers (including every
             # respawn after a kill) load the rungs the warm just wrote to
@@ -526,20 +702,28 @@ class AlignServer:
             # worker pids included so an operator (or the smoke harness)
             # can kill a worker and watch the supervisor respawn it
             out["pool"] = self._pool.snapshot()
-        if self._churn:
+        if self._churn or self._map_coalesce:
             # boardable in-flight lockstep groups: the fleet router's
             # rung-affinity signal (plan_placement prefers a replica whose
-            # open group can seat the request's rung without a new group)
+            # open group can seat the request's rung without a new group
+            # — and only same-KIND groups: a map request can't board a
+            # consensus group or vice versa)
             out["open_groups"] = self.open_groups_snapshot()
+        if self._map_static is not None:
+            out["map_graph"] = {"path": self._map_graph,
+                                "nodes": self._map_static.n_rows - 2,
+                                "batched": self._map_coalesce}
         return out
 
     # ------------------------------------------------- open-group registry
     def _open_group_update(self, gid: int, rung: int, free: int,
-                           round_i: int, live: int) -> None:
+                           round_i: int, live: int,
+                           kind: str = "consensus") -> None:
         with self._open_lock:
             self._open_groups[gid] = {"id": gid, "rung": rung,
                                       "free": max(0, free),
-                                      "round": round_i, "live": live}
+                                      "round": round_i, "live": live,
+                                      "kind": kind}
 
     def _open_group_close(self, gid: int) -> None:
         with self._open_lock:
@@ -553,13 +737,16 @@ class AlignServer:
     def _worker_loop(self) -> None:
         from ..parallel import lockstep_group_size
         from ..parallel import scheduler as _sched
-        base_k = lockstep_group_size() if self._lockstep else 1
+        coalescing = self._lockstep or self._map_coalesce
+        base_k = lockstep_group_size() if coalescing else 1
         while True:
             # divergence feedback: measured noop_set_fraction re-caps the
-            # next coalesced group's K (scheduler.noop_k_cap)
-            max_k = (_sched.noop_k_cap(base_k) if self._lockstep else 1)
+            # next coalesced group's K (scheduler.noop_k_cap). Groups are
+            # kind-homogeneous (next_group filters on head.kind), so one
+            # loop serves both /align and /map pickups.
+            max_k = (_sched.noop_k_cap(base_k) if coalescing else 1)
             group = self.admission.next_group(
-                max_k=max_k, coalesce=self._lockstep,
+                max_k=max_k, coalesce=coalescing,
                 min_qlen=(_sched.lockstep_min_qlen()
                           if self._lockstep else 0))
             if not group:
@@ -616,6 +803,9 @@ class AlignServer:
         # per-group Params copy: msa() mutates its Params (device reroute,
         # batch bookkeeping) and workers run concurrently
         abpt = copy.deepcopy(self.abpt)
+        if live[0].kind == "map":
+            self._run_map_group(live, abpt)
+            return
         if self._churn and all(j.eligible for j in live):
             from ..parallel import scheduler as _sched
             head = live[0]
@@ -889,6 +1079,109 @@ class AlignServer:
             finally:
                 self.admission.mark_done(job)
 
+    # ----------------------------------------------------------- map (/map)
+    def _run_map_group(self, jobs: List[Job], abpt: Params) -> None:
+        """Run one picked map group: every request's reads stream through
+        the shared static-graph driver (parallel/map_driver.py) with a
+        round-boundary hook that answers each request the round its last
+        read retires and claims queued same-rung /map requests onto freed
+        lanes — every round, because every map lane frees every round."""
+        from ..parallel import lockstep_group_size, map_reads_split
+        from ..parallel import scheduler as _sched
+        from ..resilience import DispatchFailed
+        if not self._map_coalesce:
+            # host route (no batched DP backend): per-read oracle, one
+            # request at a time under its own deadline
+            for job in jobs:
+                try:
+                    self._finish_map_single(job, abpt)
+                finally:
+                    self.admission.mark_done(job)
+            return
+        gid = next(self._group_ids)
+        hook = _ServeMapHook(self, abpt, gid, jobs[0].rung,
+                             _sched.noop_k_cap(lockstep_group_size()))
+        for job in jobs:
+            hook.add_job(job)
+        self._open_group_update(gid, hook.rung, hook.k_cap, 0, 0,
+                                kind="map")
+        try:
+            map_reads_split(self._map_static, [], abpt,
+                            k_cap=hook.k_cap, hook=hook, Qp=hook.rung)
+        except (DispatchFailed, RuntimeError) as e:
+            print(f"Warning: map group {gid} failed ({e}); sweeping "
+                  "members to the host path.", file=sys.stderr)
+            obs.count("fallback.map_to_host")
+        finally:
+            hook.closed = True
+            self._open_group_close(gid)
+        # sweep: any request the dispatch failure left unanswered runs
+        # the per-read host path under its own remaining deadline
+        leftovers = [st["job"] for st in hook.states.values()]
+        hook.states.clear()
+        for job in leftovers:
+            try:
+                self._finish_map_single(job, abpt)
+            finally:
+                self.admission.mark_done(job)
+
+    def _finish_map_single(self, job: Job, abpt: Params) -> None:
+        """ONE /map request on the host path (no batched backend, or the
+        group dispatch failed): per-read oracle alignments under the
+        request deadline — same GAF bytes as the batched route."""
+        from ..resilience.watchdog import DispatchTimeout, call_with_deadline
+        remaining = job.remaining_s()
+        if remaining <= 0:
+            obs.record_fault("request_timeout", detail=job.label,
+                             action="expired_in_queue",
+                             extra={"request_id": job.rid} if job.rid
+                             else None)
+            if job.finish("timeout", error="request deadline expired"):
+                self.account(job, "timeout")
+            return
+        rid_extra = {"request_id": job.rid} if job.rid else None
+        try:
+            with obs.request_ctx(job.rid):
+                body = call_with_deadline(
+                    lambda: self._run_map_host(job, abpt),
+                    deadline_s=remaining, label=job.label)
+            if job.finish("ok", body=body):
+                self.account(job, "ok")
+        except DispatchTimeout:
+            obs.record_fault("request_timeout", detail=job.label,
+                             action="worker_abandoned", extra=rid_extra)
+            if job.finish("timeout", error="request deadline expired"):
+                self.account(job, "timeout")
+        except Exception as e:  # noqa: BLE001 — worker must survive
+            obs.record_fault("request_error", detail=str(e)[:300],
+                             action="rejected_500", extra=rid_extra)
+            if job.finish("error", error=f"{type(e).__name__}: {e}"):
+                self.account(job, "error")
+
+    def _run_map_host(self, job: Job, abpt: Params) -> str:
+        import numpy as np
+        from ..io import gaf_record
+        from ..parallel import map_read_host
+        static = self._map_static
+        encode = abpt.char_to_code
+        lines = []
+        with obs.request_ctx(job.rid), \
+                obs.span("execute", "serve", args={"label": job.label,
+                                                   "kind": "map"}):
+            for rec in job.records:
+                q = encode[np.frombuffer(rec.seq.encode(), dtype=np.uint8)
+                           ].astype(np.uint8)
+                t_r = time.perf_counter()
+                with obs.phase("align"):
+                    res, strand = map_read_host(static.graph, abpt, q)
+                obs.count("map.reads")
+                obs.record_read(time.perf_counter() - t_r, len(q),
+                                2 * len(q) + 1, abpt.device)
+                lines.append(gaf_record(rec.name, q, res,
+                                        static.base_by_nid, strand,
+                                        comment=rec.comment or None))
+        return "".join(ln + "\n" for ln in lines)
+
 
 def _make_handler(server: AlignServer):
     from http.server import BaseHTTPRequestHandler
@@ -939,9 +1232,11 @@ def _make_handler(server: AlignServer):
 
         # ------------------------------------------------------ POST
         def do_POST(self):  # noqa: N802 — http.server API
-            if self.path.rstrip("/") != "/align":
+            path = self.path.rstrip("/")
+            if path not in ("/align", "/map"):
                 self._json(404, {"error": f"unknown path {self.path!r}"})
                 return
+            is_map = path == "/map"
             # the request id is minted at INGRESS — before parsing, before
             # admission — and every disposition (shed, poisoned, served)
             # answers with it, so a client-side latency outlier is
@@ -981,10 +1276,18 @@ def _make_handler(server: AlignServer):
                                           f"{max_body_bytes()} B limit"},
                            rh)
                 return
+            if is_map and server._map_static is None:
+                self.close_connection = True  # body unread
+                server.bump("poisoned", 0.0)
+                self._json(400, {"error": "no map graph loaded; start "
+                                          "serve with --map-graph FILE"},
+                           rh)
+                return
             raw = self.rfile.read(n) if n else b""
             t0 = time.perf_counter()
             try:
-                job = self._parse_job(raw, rid, attempt)
+                job = (self._parse_map_job(raw, rid, attempt) if is_map
+                       else self._parse_job(raw, rid, attempt))
             except Exception as e:  # malformed body: 400, never a crash
                 server.bump("poisoned", time.perf_counter() - t0)
                 obs.record_fault("poisoned_set", detail=str(e)[:300],
@@ -1018,7 +1321,8 @@ def _make_handler(server: AlignServer):
                     server.account(job, "timeout")
             status = job.status
             if status == "ok":
-                self._send(200, job.body.encode(), "text/x-fasta",
+                self._send(200, job.body.encode(),
+                           "text/x-gaf" if is_map else "text/x-fasta",
                            {"X-Abpoa-Reads": str(job.n_reads), **rh})
             elif status == "poisoned":
                 self._json(400, {"error": job.error}, rh)
@@ -1054,6 +1358,42 @@ def _make_handler(server: AlignServer):
                        eligible=fused_eligible(server.abpt, len(records)),
                        deadline_s=deadline, rid=rid, attempt=attempt,
                        qmax=qmax)
+
+        def _parse_map_job(self, raw: bytes, rid: str = "",
+                           attempt: int = 1) -> Job:
+            from ..io.fastx import read_fastx_text
+            from ..resilience import validate_records
+            from ..compile.ladder import qp_rung
+            from .admission import map_request_bytes
+            records = read_fastx_text(raw.decode("utf-8", errors="strict"))
+            validate_records(records, server.abpt)
+            cap = map_max_qlen()
+            for r in records:
+                if len(r.seq) > cap:
+                    # oversized-read 400: a read past the map length cap
+                    # would force an off-ladder Qp rung the warmer never
+                    # precompiled — reject at the door, not on a lane
+                    raise ValueError(
+                        f"read {r.name!r} is {len(r.seq)} bp, over the "
+                        f"map read cap {cap} bp "
+                        "(ABPOA_TPU_MAP_MAX_QLEN)")
+            deadline = server.deadline_s
+            hdr = self.headers.get("X-Abpoa-Deadline-S")
+            if hdr:
+                try:
+                    deadline = min(deadline, float(hdr))
+                except ValueError:
+                    pass
+            qmax = max(len(r.seq) for r in records)
+            # per-read pricing: the static graph plane is NOT in this
+            # request's bill — it was paid once at restore
+            return Job(records, rung=qp_rung(qmax),
+                       est_bytes=map_request_bytes(
+                           server.abpt, records,
+                           server._map_static.n_rows),
+                       eligible=server._map_coalesce,
+                       deadline_s=deadline, rid=rid, attempt=attempt,
+                       qmax=qmax, kind="map")
 
     return Handler
 
@@ -1114,6 +1454,11 @@ def _build_parser() -> argparse.ArgumentParser:
                          "admission queue and the pool-worker pipe under "
                          "one request id; `abpoa-tpu why <id>` renders "
                          "them [ABPOA_TPU_SERVE_TRACE_DIR]")
+    ap.add_argument("--map-graph", type=str, default=None, metavar="FILE",
+                    help="restore FILE (abPOA GFA or MSA FASTA — the -i "
+                         "formats) ONCE at startup and serve POST /map: "
+                         "fixed-graph read mapping, one GAF record per "
+                         "read [ABPOA_TPU_SERVE_MAP_GRAPH]")
     ap.add_argument("--device", type=str, default="auto",
                     help="DP backend: auto | numpy | native | jax | "
                          "pallas [%(default)s]")
@@ -1176,7 +1521,8 @@ def serve_main(argv) -> int:
                              queue_depth=args.queue_depth,
                              deadline_s=args.deadline_s,
                              pool_workers=args.pool_workers,
-                             trace_dir=args.trace_dir)
+                             trace_dir=args.trace_dir,
+                             map_graph=args.map_graph)
     except OSError as e:
         print(f"Error: cannot bind {args.host}:{args.port}: {e}",
               file=sys.stderr)

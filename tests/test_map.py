@@ -1,0 +1,338 @@
+"""Map-mode tests (ISSUE 18): the fixed-graph read-to-graph mapping
+workload — static DP tables built once, reads streamed through the
+vmapped pow2 batch, GAF records byte-identical to the per-read host
+oracle.
+
+The parity grid runs the jitted kernel on CPU jax (signatures cached
+across runs via .jax_cache); the serve endpoint tests run the numpy
+host route (no jax import, fast startup) — the endpoint contract is
+identical on both routes by construction, and tools/map_gate.py holds
+the batched route to oracle byte-identity in CI."""
+import io
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from make_sim import simulate
+
+REF_LEN = 120        # tiny rung: the 18-case grid must compile cheaply
+GRAPH_READS = 6
+MAP_READS = 8
+
+
+def _params(device="numpy", gap_mode=None, amb=False):
+    from abpoa_tpu import constants as C
+    from abpoa_tpu.params import Params
+    abpt = Params()
+    abpt.device = device
+    if gap_mode == C.LINEAR_GAP:
+        abpt.gap_open1, abpt.gap_open2 = 0, 0
+    elif gap_mode == C.AFFINE_GAP:
+        abpt.gap_open1, abpt.gap_ext1 = 4, 2
+        abpt.gap_open2, abpt.gap_ext2 = 0, 0
+    elif gap_mode == C.CONVEX_GAP:
+        abpt.gap_open1, abpt.gap_ext1 = 4, 2
+        abpt.gap_open2, abpt.gap_ext2 = 24, 1
+    abpt.amb_strand = 1 if amb else 0
+    return abpt.finalize()
+
+
+def _encode(abpt, seq: str) -> np.ndarray:
+    return abpt.char_to_code[
+        np.frombuffer(seq.encode(), dtype=np.uint8)].astype(np.uint8)
+
+
+_RC = str.maketrans("ACGT", "TGCA")
+
+
+def _revcomp(seq: str) -> str:
+    return seq.translate(_RC)[::-1]
+
+
+@pytest.fixture(scope="module")
+def sim_graph(tmp_path_factory):
+    """ONE simulated read set split into a restored GFA graph (first
+    reads) and a same-reference map stream with divergent read lengths
+    (alternate reads truncated)."""
+    from abpoa_tpu.io.fastx import read_fastx
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.pipeline import Abpoa, msa
+    tmp = tmp_path_factory.mktemp("map")
+    sim = str(tmp / "sim.fa")
+    simulate(REF_LEN, GRAPH_READS + MAP_READS, 0.1, 1805, sim)
+    recs = read_fastx(sim)
+    abpt = Params()
+    abpt.device = "numpy"
+    # BEFORE finalize: use_read_ids (the P-line paths) derives from it
+    abpt.out_cons, abpt.out_gfa = False, True
+    abpt = abpt.finalize()
+    buf = io.StringIO()
+    msa(Abpoa(), abpt, recs[:GRAPH_READS], buf)
+    gfa = str(tmp / "graph.gfa")
+    with open(gfa, "w") as fp:
+        fp.write(buf.getvalue())
+    reads = []
+    for i, r in enumerate(recs[GRAPH_READS:]):
+        seq = r.seq if i % 2 == 0 else r.seq[:int(len(r.seq) * 0.6)]
+        reads.append((r.name, seq))
+    return gfa, reads
+
+
+def _host_gaf(gfa, reads, abpt):
+    from abpoa_tpu.io.gaf import gaf_record
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_read_host)
+    host = _params("numpy", amb=bool(abpt.amb_strand))
+    host.gap_open1, host.gap_ext1 = abpt.gap_open1, abpt.gap_ext1
+    host.gap_open2, host.gap_ext2 = abpt.gap_open2, abpt.gap_ext2
+    host = host.finalize()
+    ab, static = load_static_graph(gfa, host)
+    lines = []
+    for name, seq in reads:
+        q = _encode(host, seq)
+        res, strand = map_read_host(ab.graph, host, q)
+        lines.append(gaf_record(name, q, res, static.base_by_nid,
+                                strand=strand))
+    return "\n".join(lines) + "\n"
+
+
+def _batched_gaf(gfa, reads, abpt, k_cap):
+    from abpoa_tpu.io.gaf import gaf_record
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_reads_split)
+    ab, static = load_static_graph(gfa, abpt)
+    queries = [_encode(abpt, seq) for _name, seq in reads]
+    out = map_reads_split(static, queries, abpt, k_cap=k_cap)
+    lines = []
+    for (name, _seq), q, res in zip(reads, queries, out):
+        assert res is not None
+        lines.append(gaf_record(name, q, res[0], static.base_by_nid,
+                                strand=res[1]))
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------- #
+# parity grid: gap regime x K x amb-strand, divergent read lengths            #
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("k_cap", [1, 4, 8])
+@pytest.mark.parametrize("gap_mode", ["linear", "affine", "convex"])
+@pytest.mark.parametrize("amb", [False, True])
+def test_map_parity_grid(sim_graph, gap_mode, k_cap, amb):
+    from abpoa_tpu import constants as C
+    mode = {"linear": C.LINEAR_GAP, "affine": C.AFFINE_GAP,
+            "convex": C.CONVEX_GAP}[gap_mode]
+    gfa, reads = sim_graph
+    if amb:
+        # flip half the reads to the minus strand: the amb-strand second
+        # dispatch must recover them, byte-identically to the host rule
+        reads = [(n, s if i % 2 == 0 else _revcomp(s))
+                 for i, (n, s) in enumerate(reads)]
+    abpt = _params("jax", gap_mode=mode, amb=amb)
+    got = _batched_gaf(gfa, reads, abpt, k_cap)
+    want = _host_gaf(gfa, reads, abpt)
+    assert got == want
+    if amb:
+        assert "\t-\t" in got   # some read actually mapped minus-strand
+
+
+def test_map_off_rung_read_skipped(sim_graph):
+    """A read past the pinned Qp rung retires as None; the rest of the
+    stream still maps, byte-identical to the oracle."""
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_reads_split)
+    gfa, reads = sim_graph
+    abpt = _params("jax")
+    ab, static = load_static_graph(gfa, abpt)
+    queries = [_encode(abpt, seq) for _n, seq in reads]
+    long_q = np.zeros(4000, dtype=np.uint8)
+    out = map_reads_split(static, [long_q] + queries, abpt, k_cap=4,
+                          Qp=256)
+    assert out[0] is None
+    assert all(r is not None for r in out[1:])
+
+
+# --------------------------------------------------------------------------- #
+# restore -> map -> restore round-trip: the graph is immutable                #
+# --------------------------------------------------------------------------- #
+
+def test_restore_map_restore_roundtrip(sim_graph):
+    from abpoa_tpu.io.output import generate_gfa
+    from abpoa_tpu.parallel.map_driver import load_static_graph
+
+    def export(ab, abpt):
+        from abpoa_tpu.params import Params
+        out = Params()
+        out.device = abpt.device
+        out.out_cons, out.out_gfa = False, True
+        out = out.finalize()
+        buf = io.StringIO()
+        generate_gfa(ab.graph, out, ab.names, ab.is_rc, lambda: None, buf)
+        return buf.getvalue()
+
+    gfa, reads = sim_graph
+    abpt = _params("jax")
+    ab, _static = load_static_graph(gfa, abpt)
+    before = export(ab, abpt)
+    first = _batched_gaf(gfa, reads, abpt, k_cap=4)
+    assert export(ab, abpt) == before       # mapping mutated nothing
+    # a second restore of the same file maps the same bytes
+    assert _batched_gaf(gfa, reads, abpt, k_cap=4) == first
+
+
+def test_static_tables_share_graph_half(sim_graph):
+    """stamp_query reuses the graph-half arrays by reference: per-read
+    stamping must never rebuild the adjacency scatter."""
+    from abpoa_tpu.parallel.map_driver import load_static_graph
+    gfa, reads = sim_graph
+    abpt = _params("jax")
+    _ab, static = load_static_graph(gfa, abpt)
+    q1, q2 = _encode(abpt, reads[0][1]), _encode(abpt, reads[1][1])
+    t1 = static.tables_for(q1, 256)
+    t2 = static.tables_for(q2, 256)
+    assert t1["pre_idx"] is t2["pre_idx"]
+
+
+# --------------------------------------------------------------------------- #
+# scheduler + admission                                                       #
+# --------------------------------------------------------------------------- #
+
+def test_plan_route_map_ignores_qlen_gate():
+    """The map route has no 1500 bp serial-vs-lockstep crossover: a map
+    deployment pinned its graph, so short reads still batch."""
+    from abpoa_tpu.parallel.scheduler import plan_route
+    route = plan_route(_params("jax"), 8, workload="map", qlen=100)
+    assert route.kind == "map"
+    assert route.k_cap >= 1
+    assert plan_route(_params("numpy"), 8, workload="map").kind == "serial"
+
+
+def test_map_request_bytes_prices_reads_only():
+    """Admission pricing for /map is linear in the read plane — the graph
+    plane was paid once at restore, not per request."""
+    from abpoa_tpu.serve.admission import map_request_bytes
+
+    class R:
+        def __init__(self, seq):
+            self.seq = seq
+
+    abpt = _params("numpy")
+    one = map_request_bytes(abpt, [R("A" * 200)], n_rows=500)
+    two = map_request_bytes(abpt, [R("A" * 200)] * 2, n_rows=500)
+    assert one > 0
+    assert two == 2 * one
+
+
+def test_ladder_declares_map_rungs():
+    from abpoa_tpu.compile.ladder import LADDER, QUICK_TIER
+    assert "run_dp_chunk[map]" in LADDER
+    assert any(a.entry == "run_dp_chunk" and a.k == 8 for a in QUICK_TIER)
+
+
+# --------------------------------------------------------------------------- #
+# POST /map endpoint contract (numpy host route)                              #
+# --------------------------------------------------------------------------- #
+
+HANDCRAFT_GFA = ("H\tVN:Z:1.0\n"
+                 "S\ts1\tACGTACGTACGTACGTACGT\n"
+                 "S\ts2\tTTGGCCAATTGGCCAATTGG\n"
+                 "P\tread1\ts1+,s2+\t*\n"
+                 "P\tread2\ts1+\t*\n")
+
+
+def _start_map_server(tmp_path, gfa_text=HANDCRAFT_GFA, **kw):
+    from abpoa_tpu.serve import AlignServer
+    path = str(tmp_path / "hand.gfa")
+    with open(path, "w") as fp:
+        fp.write(gfa_text)
+    srv = AlignServer(_params("numpy"), port=0, map_graph=path, **kw)
+    srv.start(warm="off")
+    return srv
+
+
+def _post(srv, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}", data=body, method="POST",
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_serve_map_returns_gaf(tmp_path):
+    srv = _start_map_server(tmp_path)
+    try:
+        body = b">q1\nACGTACGTACGTACGTACGTTTGGCCAATTGGCCAATTGG\n"
+        code, out, hdrs = _post(srv, "/map", body)
+        assert code == 200
+        assert hdrs.get("Content-Type", "").startswith("text/x-gaf")
+        assert hdrs.get("X-Abpoa-Reads") == "1"
+        fields = out.decode().strip().split("\t")
+        assert fields[0] == "q1"
+        assert fields[4] == "+"
+        assert any(f.startswith("cg:Z:") for f in fields)
+        # /align still serves consensus on the same server
+        code2, out2, hdrs2 = _post(srv, "/align", body)
+        assert code2 == 200
+        assert hdrs2.get("Content-Type", "").startswith("text/x-fasta")
+    finally:
+        srv.stop()
+
+
+def test_serve_map_matches_host_oracle(tmp_path, sim_graph):
+    gfa, reads = sim_graph
+    with open(gfa) as fp:
+        gfa_text = fp.read()
+    srv = _start_map_server(tmp_path, gfa_text=gfa_text)
+    try:
+        body = "".join(f">{n}\n{s}\n" for n, s in reads).encode()
+        code, out, _hdrs = _post(srv, "/map", body)
+        assert code == 200
+        assert out.decode() == _host_gaf(gfa, reads, _params("numpy"))
+    finally:
+        srv.stop()
+
+
+def test_serve_map_without_graph_400():
+    from abpoa_tpu.serve import AlignServer
+    srv = AlignServer(_params("numpy"), port=0)
+    srv.start(warm="off")
+    try:
+        code, out, _ = _post(srv, "/map", b">q\nACGT\n")
+        assert code == 400
+        assert b"map graph" in out
+    finally:
+        srv.stop()
+
+
+def test_serve_map_oversized_read_400(tmp_path, monkeypatch):
+    monkeypatch.setenv("ABPOA_TPU_MAP_MAX_QLEN", "32")
+    srv = _start_map_server(tmp_path)
+    try:
+        code, out, _ = _post(srv, "/map", b">big\n" + b"A" * 64 + b"\n")
+        assert code == 400
+        assert b"map read cap" in out
+        # a read under the cap still maps fine on the same connection
+        code2, _out2, _ = _post(srv, "/map", b">ok\nACGTACGTACGT\n")
+        assert code2 == 200
+    finally:
+        srv.stop()
+
+
+def test_serve_healthz_advertises_map_graph(tmp_path):
+    import json
+    srv = _start_map_server(tmp_path)
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        mg = health.get("map_graph") or {}
+        assert mg.get("nodes", 0) > 2
+        assert mg.get("batched") is False   # numpy host route
+    finally:
+        srv.stop()

@@ -54,8 +54,10 @@ class LoadGen:
     def __init__(self, url: str, payloads: List[bytes], rate: float,
                  n: int, timeout_s: float = 60.0, max_inflight: int = 256,
                  deadline_hdr: Optional[float] = None,
-                 fleet: bool = False) -> None:
+                 fleet: bool = False,
+                 endpoint: str = "/align") -> None:
         self.url = url.rstrip("/")
+        self.endpoint = endpoint
         self.payloads = payloads
         self.rate = rate
         self.n = n
@@ -87,7 +89,7 @@ class LoadGen:
         headers = {"Content-Type": "text/x-fasta"}
         if self.deadline_hdr is not None:
             headers["X-Abpoa-Deadline-S"] = str(self.deadline_hdr)
-        req = urllib.request.Request(self.url + "/align", data=payload,
+        req = urllib.request.Request(self.url + self.endpoint, data=payload,
                                      method="POST", headers=headers)
         t0 = time.perf_counter()
         code, body, rid, hdrs = 0, b"", None, None
@@ -226,7 +228,7 @@ def run_ab(url_churn: str, url_baseline: str, payloads: List[bytes],
 
 def run_sweep(url: str, payloads: List[bytes], rates: List[float],
               n_per_rate: int, timeout_s: float = 60.0,
-              fleet: bool = False) -> List[dict]:
+              fleet: bool = False, endpoint: str = "/align") -> List[dict]:
     """The overload-rejection curve: one open-loop run per arrival rate,
     ascending — PERF.md's served-throughput figure. With `fleet`, each
     pass also attributes responses per replica and counts the router's
@@ -234,7 +236,8 @@ def run_sweep(url: str, payloads: List[bytes], rates: List[float],
     out = []
     for rate in rates:
         out.append(LoadGen(url, payloads, rate, n_per_rate,
-                           timeout_s=timeout_s, fleet=fleet).run())
+                           timeout_s=timeout_s, fleet=fleet,
+                           endpoint=endpoint).run())
     return out
 
 
@@ -259,6 +262,11 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", type=str, default=None, metavar="R1,R2,...",
                     help="run the overload curve: one pass per rate, "
                          "--n requests each; output is a JSON list")
+    ap.add_argument("--map", dest="map_mode", action="store_true",
+                    help="map mode: POST every payload (FASTQ read "
+                         "bodies) to /map against the server's preloaded "
+                         "--map-graph instead of /align; responses are "
+                         "GAF, one record per read")
     ap.add_argument("--fleet", action="store_true",
                     help="target is an `abpoa-tpu fleet` router: "
                          "attribute every response to its replica "
@@ -274,6 +282,7 @@ def main(argv=None) -> int:
                     help="write the JSON summary to FILE (stdout always "
                          "gets it too)")
     args = ap.parse_args(argv)
+    endpoint = "/map" if args.map_mode else "/align"
     payloads = []
     for p in args.payload:
         with open(p, "rb") as fp:
@@ -287,14 +296,15 @@ def main(argv=None) -> int:
     elif args.sweep:
         rates = [float(r) for r in args.sweep.split(",")]
         result = run_sweep(args.url, payloads, rates, args.n,
-                           timeout_s=args.timeout_s, fleet=args.fleet)
+                           timeout_s=args.timeout_s, fleet=args.fleet,
+                           endpoint=endpoint)
         worst = max((r["errors"] for r in result), default=0)
     else:
         result = LoadGen(args.url, payloads, args.rate, args.n,
                          timeout_s=args.timeout_s,
                          max_inflight=args.max_inflight,
                          deadline_hdr=args.deadline_s,
-                         fleet=args.fleet).run()
+                         fleet=args.fleet, endpoint=endpoint).run()
         worst = result["errors"]
     text = json.dumps(result, indent=1)
     print(text)

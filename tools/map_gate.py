@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Map-mode gate (CI: perf-gate job, beside lockstep_gate/churn_gate).
+
+PR 18's claim is a throughput claim: against ONE static graph whose
+lockstep DP tables were built ONCE, streaming reads through the vmapped
+pow2-batch kernel must strictly dominate serial per-read alignment — the
+same kernel dispatched one read at a time (K=1). The graph half of every
+dispatch is identical, so batching amortizes dispatch + graph-plane cost
+over K lanes; this gate measures that on every host:
+
+- workload: ONE simulated read set (tests/make_sim.py), split into graph
+  reads (build the POA graph via the numpy consensus path) and map reads
+  — same reference, so alignments are real, not band-edge garbage
+- A: batched map (`map_reads_split`, k_cap=8); B: serial per-read (same
+  static tables, k_cap=1), identical read order
+- gate 1: batched reads/s AND CUPS strictly exceed serial's
+- gate 2: batched GAF output byte-identical to the per-read HOST oracle
+  (`map_read_host`, the numpy reference path) — throughput never buys
+  drift
+- gate 3: zero compile misses inside either timed window (both shapes
+  warmed beforehand; in CI `warm --ladder quick` makes the warm pass a
+  persistent-cache load)
+- gate 4: measured map-lane occupancy (per-round live/capacity, run
+  mean) exceeds the consensus churn path's 0.844 — with zero fusion
+  barrier every round boundary reboards, so lanes must stay fuller
+
+Exits 0 on pass, 1 on a violation. --inject-slowdown F (test hook)
+divides the batched reads/s and CUPS by F to prove the gate flips.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+
+REF_LEN = 2000          # the quick-tier warm anchor's shape (qmax 2200)
+GRAPH_READS = 8         # consensus reads that build the static graph
+K_CAP = 8
+CONSENSUS_OCC = 0.844   # PR 17's measured churn occupancy (PERF.md r17)
+
+
+def _payload(n_map_reads: int):
+    """ONE sim file, split: the graph is built from the FIRST reads and
+    the map stream is the REST — same reference (make_sim derives the
+    reference from the seed, so separate files would be two unrelated
+    genomes and every mapping would be band-edge garbage)."""
+    n_total = GRAPH_READS + n_map_reads
+    sim = os.path.join("/tmp", f"map_gate_{n_total}x{REF_LEN}.fa")
+    if not os.path.isfile(sim):
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests", "make_sim.py"),
+             "--ref-len", str(REF_LEN), "--n-reads", str(n_total),
+             "--err", "0.1", "--seed", "1800", "--out", sim], check=True)
+    from abpoa_tpu.io.fastx import read_fastx
+    recs = read_fastx(sim)
+    assert len(recs) == n_total
+    graph_fa = os.path.join("/tmp", f"map_gate_graph_{REF_LEN}.fa")
+    with open(graph_fa, "w") as fp:
+        for r in recs[:GRAPH_READS]:
+            fp.write(f">{r.name}\n{r.seq}\n")
+    gfa = os.path.join("/tmp", f"map_gate_graph_{REF_LEN}.gfa")
+    if not os.path.isfile(gfa):
+        subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", graph_fa,
+             "-r", "4", "--device", "numpy", "-o", gfa],
+            cwd=REPO, check=True)
+    return gfa, recs[GRAPH_READS:]
+
+
+def _gaf(records, queries, outcomes, base_by_nid) -> bytes:
+    from abpoa_tpu.io.gaf import gaf_record
+    lines = []
+    for rec, q, out in zip(records, queries, outcomes):
+        res, strand = out[0], out[1]
+        lines.append(gaf_record(rec.name, q, res, base_by_nid,
+                                strand=strand))
+    return ("\n".join(lines) + "\n").encode()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    metavar="F", help="divide batched reads/s and CUPS "
+                    "by F (test hook proving the gate flips)")
+    ap.add_argument("--n-reads", type=int, default=32,
+                    help="map-stream read count (a multiple of the k_cap "
+                         "keeps every round full) [%(default)s]")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from abpoa_tpu import obs
+    from abpoa_tpu.compile.warm import warm_ladder
+    from abpoa_tpu.parallel import scheduler
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_read_host,
+                                               map_reads_split)
+    from abpoa_tpu.params import Params
+
+    t0 = time.perf_counter()
+    w = warm_ladder("quick")
+    print(f"[map-gate] quick-ladder warm: {w['compiled']} compiled, "
+          f"{w['persistent_cache_hits']} cache loads, "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    gfa, map_recs = _payload(args.n_reads)
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.finalize()
+    ab, static = load_static_graph(gfa, abpt)
+    encode = abpt.char_to_code
+    queries = [encode[np.frombuffer(r.seq.encode(), dtype=np.uint8)]
+               .astype(np.uint8) for r in map_recs]
+    cells = sum(static.n_rows * (2 * len(q) + 1) for q in queries)
+    print(f"[map-gate] graph {ab.graph.node_n} nodes "
+          f"({static.n_rows} DP rows), {len(queries)} map reads, "
+          f"{cells / 1e6:.1f}M cells/side", file=sys.stderr)
+
+    # the per-read HOST oracle: every GAF byte both timed sides must match
+    oracle = _gaf(map_recs, queries,
+                  [map_read_host(ab.graph, abpt, q) for q in queries],
+                  static.base_by_nid)
+
+    # warm BOTH timed shapes (K=8 rounds and the K=1 serial signature)
+    # before anything is measured — in CI the preceding `warm --ladder
+    # quick` step makes these persistent-cache loads, and gate 3 holds
+    # the timed windows to zero misses
+    map_reads_split(static, queries, abpt, k_cap=K_CAP)
+    map_reads_split(static, queries[:1], abpt, k_cap=1)
+
+    obs.start_run()
+    scheduler.reset()
+
+    # ---- serial per-read: same kernel, same tables, K=1 -------------- #
+    t0 = time.perf_counter()
+    serial_out = map_reads_split(static, queries, abpt, k_cap=1)
+    wall_serial = time.perf_counter() - t0
+    serial_rps = len(queries) / wall_serial
+    serial_cups = cells / wall_serial
+
+    # ---- batched: k_cap lanes, zero fusion barrier ------------------- #
+    scheduler.reset()
+    t0 = time.perf_counter()
+    batched_out = map_reads_split(static, queries, abpt, k_cap=K_CAP)
+    wall_batched = time.perf_counter() - t0
+    batched_rps = len(queries) / wall_batched
+    batched_cups = cells / wall_batched
+    occ = scheduler.occupancy_mean()
+
+    rep = obs.finalize_report()
+    misses = (rep.get("compiles") or {}).get("misses", 0)
+
+    if args.inject_slowdown:
+        f = args.inject_slowdown
+        batched_rps /= f
+        batched_cups /= f
+        print(f"[map-gate] injected {f}x batched slowdown (test hook)",
+              file=sys.stderr)
+
+    print(f"[map-gate] serial  (K=1): {serial_rps:8.2f} reads/s  "
+          f"{serial_cups / 1e6:8.1f}M CUPS  ({wall_serial:.2f}s)",
+          file=sys.stderr)
+    print(f"[map-gate] batched (K={K_CAP}): {batched_rps:8.2f} reads/s  "
+          f"{batched_cups / 1e6:8.1f}M CUPS  ({wall_batched:.2f}s)  "
+          f"-> {batched_rps / serial_rps:.2f}x", file=sys.stderr)
+    print(f"[map-gate] map-lane occupancy {occ:.3f} "
+          f"(consensus churn path: {CONSENSUS_OCC}) | compile misses in "
+          f"timed windows: {misses}", file=sys.stderr)
+
+    rc = 0
+    if not (batched_rps > serial_rps and batched_cups > serial_cups):
+        print("[map-gate] FAIL: batched map does not strictly dominate "
+              "serial per-read alignment on reads/s AND CUPS",
+              file=sys.stderr)
+        rc = 1
+    gaf_batched = _gaf(map_recs, queries, batched_out, static.base_by_nid)
+    gaf_serial = _gaf(map_recs, queries, serial_out, static.base_by_nid)
+    if gaf_batched != oracle:
+        print("[map-gate] FAIL: batched GAF is NOT byte-identical to the "
+              "per-read host oracle", file=sys.stderr)
+        rc = 1
+    if gaf_serial != oracle:
+        print("[map-gate] FAIL: serial (K=1) GAF is NOT byte-identical "
+              "to the per-read host oracle", file=sys.stderr)
+        rc = 1
+    if misses:
+        print(f"[map-gate] FAIL: {misses} compile misses inside the "
+              "timed windows — the warm pass did not cover a shape",
+              file=sys.stderr)
+        rc = 1
+    if not occ > CONSENSUS_OCC:
+        print(f"[map-gate] FAIL: map-lane occupancy {occ:.3f} does not "
+              f"exceed the consensus path's {CONSENSUS_OCC} — the "
+              "zero-barrier reboard is not keeping lanes full",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("[map-gate] PASS", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

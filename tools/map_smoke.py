@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Map-mode chaos soak: the served form of PR 18's acceptance criteria.
+
+Starts `abpoa-tpu serve --map-graph` (device jax pinned to CPU) with the
+fault injectors armed, then drives `POST /map` with `tools/loadgen.py
+--map` at ~2x the calibrated sustainable throughput. The server must:
+
+- never crash: zero transport errors client-side, no Traceback in its
+  stderr, SIGTERM drain rc 0;
+- shed overload as 429 + Retry-After, never by queueing without bound;
+- keep every 200 byte-identical to the per-read HOST oracle
+  (`map_read_host`) — through injected faults, the map group falls back
+  to the host route rather than drift;
+- leave a lint-clean Prometheus exposition carrying the map families
+  (abpoa_map_reads_total et al.) and an archive window on which
+  `abpoa-tpu slo` passes — map requests are first-class archive
+  citizens, so `abpoa-tpu why <rid>` works on them verbatim.
+
+    python tools/map_smoke.py [--requests N] [--no-inject] [--keep]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+sys.path.insert(0, REPO)
+sys.path.insert(0, TOOLS)
+
+REF_LEN = 2000          # the quick-tier warm anchor's shape
+GRAPH_READS = 8
+READS_PER_BODY = 4
+
+
+def build_payloads(tmp: str):
+    """ONE sim file split into graph reads (-> the GFA the server
+    restores) and map-read request bodies — same reference, so the
+    mappings are real alignments (make_sim derives the reference from
+    the seed; separate files would be unrelated genomes)."""
+    from abpoa_tpu.io.fastx import read_fastx
+    sim = os.path.join(tmp, "map_smoke.fa")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "make_sim.py"),
+         "--ref-len", str(REF_LEN), "--n-reads", str(GRAPH_READS + 16),
+         "--err", "0.1", "--seed", "1801", "--out", sim], check=True)
+    recs = read_fastx(sim)
+    graph_fa = os.path.join(tmp, "map_smoke_graph.fa")
+    with open(graph_fa, "w") as fp:
+        for r in recs[:GRAPH_READS]:
+            fp.write(f">{r.name}\n{r.seq}\n")
+    gfa = os.path.join(tmp, "map_smoke_graph.gfa")
+    subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", graph_fa,
+         "-r", "4", "--device", "numpy", "-o", gfa],
+        cwd=REPO, check=True)
+    bodies = []
+    map_recs = recs[GRAPH_READS:]
+    for i in range(0, len(map_recs), READS_PER_BODY):
+        chunk = map_recs[i:i + READS_PER_BODY]
+        bodies.append(("".join(f">{r.name}\n{r.seq}\n" for r in chunk)
+                       .encode(), chunk))
+    return gfa, bodies
+
+
+def oracle_bodies(gfa: str, bodies) -> set:
+    """The per-read host-oracle GAF response bytes, one per request body
+    — every healthy /map 200 must match one of these byte for byte."""
+    import numpy as np
+    from abpoa_tpu.io.gaf import gaf_record
+    from abpoa_tpu.params import Params
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_read_host)
+    abpt = Params()
+    abpt.device = "numpy"
+    abpt.finalize()
+    ab, static = load_static_graph(gfa, abpt)
+    encode = abpt.char_to_code
+    out = set()
+    for _raw, chunk in bodies:
+        lines = []
+        for r in chunk:
+            q = encode[np.frombuffer(r.seq.encode(), dtype=np.uint8)] \
+                .astype(np.uint8)
+            res, strand = map_read_host(ab.graph, abpt, q)
+            lines.append(gaf_record(r.name, q, res, static.base_by_nid,
+                                    strand=strand))
+        out.add(("\n".join(lines) + "\n").encode())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=80,
+                    help="soak request count [%(default)s]")
+    ap.add_argument("--no-inject", action="store_true",
+                    help="skip the fault injectors (pure overload soak)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir for inspection")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+    from serve_smoke import _drain_stderr, read_port, wait_ready
+
+    tmp = tempfile.mkdtemp(prefix="abpoa_map_smoke_")
+    metrics_path = os.path.join(tmp, "metrics.prom")
+    archive_dir = os.path.join(tmp, "reports")
+    failures: list = []
+
+    gfa, bodies = build_payloads(tmp)
+    oracles = oracle_bodies(gfa, bodies)
+    payloads = [raw for raw, _chunk in bodies]
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ABPOA_TPU_SKIP_PROBE="1",
+        ABPOA_TPU_ARCHIVE="1",
+        ABPOA_TPU_ARCHIVE_DIR=archive_dir,
+        ABPOA_TPU_SERVE_QUEUE="8",
+    )
+    if not args.no_inject:
+        env["ABPOA_TPU_INJECT"] = "compile_fail:1,oom:1,garbage:1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "abpoa_tpu.cli", "serve", "--port", "0",
+         "--device", "jax", "--workers", "2", "--warm", "quick",
+         "--map-graph", gfa, "--metrics", metrics_path],
+        cwd=REPO, env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        port = read_port(proc)
+        base = f"http://127.0.0.1:{port}"
+        stderr_tail: list = []
+        threading.Thread(target=_drain_stderr, args=(proc, stderr_tail),
+                         daemon=True).start()
+        wait_ready(base, proc)
+
+        from loadgen import LoadGen
+
+        # ---- calibrate on the warm server ---------------------------- #
+        cal = LoadGen(base, payloads, rate=2.0, n=6, timeout_s=300,
+                      endpoint="/map").run()
+        p50_s = (cal["latency_ms"]["p50"] or 500.0) / 1e3
+        sustainable = 2 / max(1e-3, p50_s)   # 2 workers
+        rate = min(max(2.0, 2.0 * sustainable), 100.0)
+        print(f"[map-smoke] calibrated p50={p50_s * 1e3:.0f}ms -> "
+              f"sustainable ~{sustainable:.1f}/s, soaking at "
+              f"{rate:.1f}/s x {args.requests} requests", flush=True)
+
+        # ---- the soak: 2x overload on /map --------------------------- #
+        gen = LoadGen(base, payloads, rate=rate, n=args.requests,
+                      timeout_s=300, deadline_hdr=60.0, endpoint="/map")
+        soak = gen.run()
+        print("[map-smoke] soak:", json.dumps(soak), flush=True)
+
+        # ---- settle, then read the server's own story ---------------- #
+        settle = LoadGen(base, payloads, rate=2.0, n=6, timeout_s=300,
+                         endpoint="/map").run()
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            expo = r.read().decode()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+
+        # ---- assertions ---------------------------------------------- #
+        if soak["errors"] or settle["errors"]:
+            failures.append(f"transport errors: soak={soak['errors']} "
+                            f"settle={settle['errors']}")
+        if soak["status"].get("500"):
+            failures.append(f"{soak['status']['500']} 500s in the soak")
+        if settle["ok"] != 6:
+            failures.append(f"settle window not fully healthy: "
+                            f"{settle['status']}")
+        if not (health.get("map_graph") or {}).get("nodes"):
+            failures.append(f"healthz carries no map_graph block: "
+                            f"{health.get('map_graph')}")
+        bad = sum(1 for b in gen.bodies_ok if b not in oracles)
+        if bad:
+            failures.append(f"{bad}/{len(gen.bodies_ok)} healthy /map "
+                            "responses NOT byte-identical to the "
+                            "per-read host oracle")
+
+        from abpoa_tpu.obs import metrics as M
+        lint = M.lint_exposition(expo)
+        if lint:
+            failures.append(f"exposition lint: {lint[:3]}")
+        samples, _types = M.parse_exposition(expo)
+        for fam in ("abpoa_map_reads_total", "abpoa_map_rounds_total",
+                    "abpoa_map_lane_occupancy"):
+            v = sum(v for (n, _l), v in samples.items() if n == fam)
+            if not v:
+                failures.append(f"{fam} missing/zero in the exposition")
+
+        # ---- graceful drain ------------------------------------------ #
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+        if rc != 0:
+            failures.append(f"SIGTERM drain exited rc={rc}")
+        if "Traceback" in "".join(stderr_tail):
+            failures.append("server stderr carries a Traceback:\n"
+                            + "".join(stderr_tail)[-2000:])
+
+        # ---- the archive answers `abpoa-tpu slo` for /map runs ------- #
+        slo = subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "slo"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=120)
+        sys.stdout.write(slo.stdout)
+        if slo.returncode != 0:
+            failures.append(f"`abpoa-tpu slo` rc={slo.returncode} on the "
+                            f"/map archive:\n{slo.stdout}\n{slo.stderr}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if args.keep:
+            print(f"[map-smoke] work dir kept: {tmp}")
+
+    if failures:
+        for f in failures:
+            print(f"[map-smoke] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[map-smoke] PASS: {args.requests} /map requests at 2x "
+          "overload — zero transport errors, healthy GAF bytes "
+          "oracle-identical, map families exposed lint-clean, drain "
+          "rc=0, slo ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
