@@ -385,12 +385,23 @@ def _pad_tables(t: dict, R: int, P: int) -> dict:
 
 
 def dispatch_dp_chunk(abpt: Params, table_list: List[dict], Kb: int, R: int,
-                      P: int, Qp: int, W: int, plane16: bool) -> np.ndarray:
+                      P: int, Qp: int, W: int, plane16: bool,
+                      mesh=None) -> np.ndarray:
     """Pad `table_list` to the shared (R, P) rungs and Kb set slots (zero
     no-op sets), dispatch ONE run_dp_chunk, return the
     (len(table_list), ...) packed rows. Padding slots carry
     n_rows=2/qlen=0: the backtrack exits at (0, 0) and the row loop sees
-    every row inactive."""
+    every row inactive.
+
+    With a `mesh` (jax.sharding.Mesh of >= 2 devices) the round runs
+    sharded instead: `parallel.shard.shard_dp_round` reshapes the lane
+    axis to (mesh, Kb/mesh) and dispatches ONE shard_map(vmap) round —
+    same padding, same packing, byte-identical rows. The drivers stay
+    mesh-agnostic: every dispatch site threads its mesh through here."""
+    if mesh is not None and mesh.devices.size > 1:
+        from ..parallel.shard import shard_dp_round
+        return shard_dp_round(abpt, table_list, Kb, R, P, Qp, W, plane16,
+                              mesh)
     max_ops = R + Qp + 8
     k_real = len(table_list)
     padded = [_pad_tables(t, R, P) for t in table_list]

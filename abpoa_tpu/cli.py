@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 = auto (one per core on multicore CPU hosts), "
                         "1 = in-process serial "
                         "[ABPOA_TPU_WORKERS or %(default)s]")
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="shard each split-lockstep/map round over an "
+                        "N-device lane mesh (the scheduler's sharded "
+                        "route; global K = N x the per-chip cap; 1-core "
+                        "hosts get the virtual CPU mesh only on this "
+                        "explicit request) [ABPOA_TPU_MESH]")
     p.add_argument("--report", type=str, default=None, metavar="FILE",
                    help="write a structured JSON run report (versioned "
                         "schema: phase wall-times, dispatch/fallback/"
@@ -171,6 +177,13 @@ def args_to_params(args: argparse.Namespace) -> Params:
     if args.workers < 0:
         raise SystemExit("Error: --workers must be >= 0 (0 = auto).")
     abpt.workers = args.workers
+    if getattr(args, "mesh", None) is not None:
+        if args.mesh < 0:
+            raise SystemExit("Error: --mesh must be >= 0 (0 = off).")
+        # ONE grammar: the env var is the definition site every consumer
+        # reads (scheduler.plan_route via shard.requested_mesh_size), so
+        # the flag just sets it before any route is planned
+        os.environ["ABPOA_TPU_MESH"] = str(args.mesh)
     return abpt
 
 
@@ -299,6 +312,11 @@ def map_main(argv) -> int:
                          "group size under the measured-occupancy cap)")
     ap.add_argument("--device", type=str, default="auto",
                     help="DP backend: auto | numpy | jax | pallas")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="shard the read batch over an N-device mesh "
+                         "(sharded route: global K = N x per-chip cap; "
+                         "on a 1-core host an explicit request builds the "
+                         "virtual CPU mesh) [ABPOA_TPU_MESH]")
     ap.add_argument("-V", "--verbose", type=int, default=0)
     ap.add_argument("--report", type=str, default=None, metavar="FILE")
     ap.add_argument("--trace", type=str, default=None, metavar="FILE")
@@ -306,6 +324,12 @@ def map_main(argv) -> int:
                     default=None, const="")
     ap.add_argument("--metrics-port", type=int, default=None, metavar="N")
     args = ap.parse_args(argv)
+    if args.mesh is not None:
+        if args.mesh < 0:
+            print("Error: --mesh must be >= 0 (0 = off).", file=sys.stderr)
+            return 1
+        # one grammar: the env var is the definition site (shard.py reads it)
+        os.environ["ABPOA_TPU_MESH"] = str(args.mesh)
 
     abpt = Params()
     abpt.match = args.match
@@ -382,9 +406,16 @@ def _map_run(args, abpt) -> int:
         if abpt.verbose:
             print(f"[abpoa_tpu::map] route {route.kind}: {route.reason}",
                   file=sys.stderr)
-        if route.kind == "map":
+        if route.kind in ("map", "sharded"):
+            mesh = None
+            if route.kind == "sharded":
+                # build the mesh before the first dispatch touches the
+                # backend — the virtual CPU pin is a no-op after init
+                from .parallel import discover_mesh
+                mesh = discover_mesh(route.workers)
             k_cap = args.k_cap if args.k_cap > 0 else route.k_cap
-            outcomes = map_reads_split(static, queries, abpt, k_cap=k_cap)
+            outcomes = map_reads_split(static, queries, abpt, k_cap=k_cap,
+                                       mesh=mesh)
         else:
             # host route (no batched DP backend): the per-read oracle IS
             # the mapper; same records, same counters, serial wall

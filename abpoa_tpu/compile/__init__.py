@@ -13,7 +13,7 @@ so host-only runs (numpy/native) never pay a jax import through here.
 from .buckets import bucket, bucket_pow2, grow_node_cap, snap
 from .cache import cache_dir, enable_persistent_cache
 from .ladder import (LADDER, QUICK_TIER, FULL_TIER, WarmAnchor, k_rung,
-                     ladder_axes, on_ladder, qp_rung, reads_rung)
+                     ladder_axes, mesh_rung, on_ladder, qp_rung, reads_rung)
 from .registry import entry_names, jit_handle, register_entry, watch
 
 __all__ = [
@@ -21,6 +21,7 @@ __all__ = [
     "cache_dir", "enable_persistent_cache",
     "LADDER", "QUICK_TIER", "FULL_TIER", "WarmAnchor",
     "ladder_axes", "on_ladder", "qp_rung", "reads_rung", "k_rung",
+    "mesh_rung",
     "entry_names", "jit_handle", "register_entry", "watch",
     "warm_ladder",
 ]

@@ -37,6 +37,7 @@ GEOM_1024 = geom_chain(1024, 1 << 24)   # N: fused node capacity (+growth)
 POW2 = pow2_chain(1, 1 << 24)           # E/A/P/O/SR/B/K and W growth
 POW2_128 = pow2_chain(128, 1 << 24)     # W: band window width
 POW2_READS = pow2_chain(8, 1 << 17)     # padded read rows
+MESH = pow2_chain(1, 256)               # sharded lane-mesh width (devices)
 
 LADDER = {
     "run_fused_chunk": {
@@ -65,6 +66,15 @@ LADDER = {
     # "run_dp_chunk" (one cache, shared with the consensus split driver).
     "run_dp_chunk[map]": {
         "R": GEOM_64, "Qp": GEOM_128, "W": POW2_128, "P": POW2, "K": POW2,
+    },
+    # sharded route (PR 19): shard_map(vmap(run_dp_chunk)) over a 1-axis
+    # lane mesh. K here is the PER-SHARD lane rung (pow2, same chain as
+    # the unsharded K axis); the mesh axis is the device width, so the
+    # global lane count of a sharded dispatch is mesh x K — exactly the
+    # rung grammar parallel/shard.shard_dp_round buckets under.
+    "run_dp_chunk[sharded]": {
+        "R": GEOM_64, "Qp": GEOM_128, "W": POW2_128, "P": POW2, "K": POW2,
+        "mesh": MESH,
     },
 }
 
@@ -109,6 +119,14 @@ def k_rung(k: int, mesh_size: int = 1) -> int:
     return r
 
 
+def mesh_rung(n: int) -> int:
+    """Sharded lane-mesh width rung: pow2 up to the declared 256-device
+    cap. Raises past the cap (snap's "beyond the declared ladder cap")
+    instead of silently compiling an off-ladder mesh shape — the cap-raise
+    property test pins this."""
+    return snap(max(n, 1), MESH)
+
+
 def plan_chunk_buckets(abpt, qmax: int):
     """(Qp, W, local_mode) for a fused-chunk workload whose longest read
     is qmax — THE definition site shared by the fused planner
@@ -146,8 +164,9 @@ class WarmAnchor(NamedTuple):
     qmax: int
     n_reads: int
     growth: int = 1
-    k: Optional[int] = None       # lockstep only
+    k: Optional[int] = None       # lockstep only (sharded: PER-SHARD k)
     windows: Optional[int] = None  # dp_full_batch only: window batch B
+    mesh: Optional[int] = None     # sharded only: declared mesh width
 
 
 # quick: the smoke/test scale plus the sim2k serve shape (2 kb reads).
@@ -169,6 +188,14 @@ QUICK_TIER: Tuple[WarmAnchor, ...] = (
     # rungs as the k=4 anchor above, so only the K=8 signatures compile
     # fresh — the 4/2/1 halvings are in-process cache hits.
     WarmAnchor("run_dp_chunk", qmax=2200, n_reads=16, growth=2, k=8),
+    # sharded route at the shard-gate protocol shape: per-shard K rungs
+    # {2, 1} (global lanes = mesh x {2, 1}: 16 and 8 on the virtual
+    # 8-mesh) over the same 2 kb Qp/R rungs as the anchors above. The
+    # warmer sizes the mesh from the OPERATOR'S request
+    # (ABPOA_TPU_MESH/--mesh) and is a recorded skip when none is set —
+    # sharded warm shapes exist only where sharded dispatches can.
+    WarmAnchor("run_dp_chunk[sharded]", qmax=2200, n_reads=16, growth=2,
+               k=2, mesh=8),
 )
 
 # full: quick + the north-star 10 kb consensus shape, the lockstep `-l`

@@ -48,6 +48,7 @@ def warm_ladder(tier: str = "quick", abpt=None,
     from ..align import dp_chunk  # noqa: F401
     from ..align import fused_loop  # noqa: F401
     from ..align import jax_backend  # noqa: F401
+    from ..parallel import shard  # noqa: F401
 
     t0 = time.perf_counter()
     records = []

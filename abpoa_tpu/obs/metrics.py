@@ -597,7 +597,7 @@ def set_breaker_state(backend: str, open_: bool) -> None:
             1 if open_ else 0, backend=backend)
 
 
-_ROUTE_KINDS = ("serial", "pool", "lockstep", "hybrid", "map")
+_ROUTE_KINDS = ("serial", "pool", "lockstep", "hybrid", "map", "sharded")
 
 
 def publish_noop_fraction(ewma: float) -> None:
@@ -634,6 +634,34 @@ def publish_map_round(reads: int, occ: float) -> None:
     _REGISTRY.gauge(
         "abpoa_map_round_reads",
         "Reads dispatched in the last map-driver round").set(reads)
+
+
+def publish_mesh(n: int, platform: str) -> None:
+    """Mesh inventory of the sharded route: device count and platform of
+    the lane mesh the last sharded dispatch spanned (also set at serve
+    start, so /healthz and `top` agree on the mesh shape)."""
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge(
+        "abpoa_mesh_devices",
+        "Devices in the sharded route's lane mesh").set(n)
+    _REGISTRY.gauge(
+        "abpoa_mesh_platform_info",
+        "Mesh platform marker (1 = the labelled platform backs the "
+        "mesh)").set(1, platform=platform)
+
+
+def publish_shard_occupancy(shard_i: int, occ: float) -> None:
+    """Per-shard lane occupancy of the last sharded round: live lanes over
+    the shard's K/mesh slice. Padding lanes are born finished, so trailing
+    shards of a partly-filled global batch read < 1.0 here while the
+    leading shards read 1.0 — the skew IS the repack quality signal."""
+    if _ENABLED:
+        _REGISTRY.gauge(
+            "abpoa_shard_lane_occupancy",
+            "Lane occupancy per mesh shard in the last sharded round "
+            "(live lanes over the per-shard slice)").set(
+            occ, shard=str(shard_i))
 
 
 def publish_join_wait(wait_s: float) -> None:

@@ -126,6 +126,21 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
             parts.append("  ".join(f"{k}={v:.0f}"
                                    for k, v in sorted(routes.items())))
         lines.append("sched    " + "  ".join(parts))
+        # mesh row (present only when the sharded route built a device
+        # mesh): device count, platform, per-shard lane occupancy
+        mesh_n = M.sample_value(samples, "abpoa_mesh_devices")
+        if mesh_n:
+            plat = next((dict(lb).get("platform", "?")
+                         for (n, lb) in samples
+                         if n == "abpoa_mesh_platform_info"), "?")
+            shard_occ = _labeled(samples, "abpoa_shard_lane_occupancy",
+                                 "shard")
+            occ_s = ""
+            if shard_occ:
+                occ_s = "  occ " + " ".join(
+                    f"{s}:{v:.2f}" for s, v in sorted(
+                        shard_occ.items(), key=lambda kv: int(kv[0])))
+            lines.append(f"         mesh {mesh_n:.0f}x{plat}{occ_s}")
         chunks = _total(samples, "abpoa_lockstep_chunks_total")
         drains = _total(samples, "abpoa_lockstep_drain_chunks_total")
         if chunks:

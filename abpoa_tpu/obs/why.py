@@ -270,6 +270,12 @@ def render_why(record: Optional[dict], trace_doc: Optional[dict],
                     if (record.get("attempt") or 1) > 1 else "")
                  + (f"  at {record.get('ts')}" if record.get("ts") else ""))
     lines.append(head)
+    # sharded route (PR 19): the record prices the whole mesh — name the
+    # global K cap and the mesh it was spread over
+    if record and record.get("mesh"):
+        lines.append(f"route: {record.get('route') or 'sharded'} "
+                     f"K={record.get('k_cap') or '?'} "
+                     f"over mesh={record['mesh']}")
     lines.append("")
     lines.append("verdict: " + verdict(record, trace_doc, dump))
 

@@ -6,3 +6,5 @@ from .runner import (flush_lockstep_group, flush_lockstep_group_churn,
 from .map_driver import (MapHook, load_static_graph, map_read_host,
                          map_reads_split)
 from .scheduler import Route, plan_route
+from .shard import (discover_mesh, mesh_size, pin_virtual_cpu_mesh,
+                    requested_mesh_size, shard_dp_round, shard_vmap)

@@ -89,7 +89,8 @@ def _round_delay_s() -> float:
 def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                                 weight_sets: List[List[np.ndarray]],
                                 abpt: Params,
-                                churn: Optional[ChurnHook] = None) -> list:
+                                churn: Optional[ChurnHook] = None,
+                                mesh=None) -> list:
     """Run K independent read sets in split lockstep.
 
     Returns one entry per INITIAL set: `(host_graph, is_rc_flags)`, or
@@ -102,6 +103,14 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
     boundaries: results (initial sets AND joiners) are additionally
     delivered through `churn.on_retire` the round each lane finishes, and
     `churn.on_round` may evict expired lanes or board same-rung joiners.
+
+    `mesh` (a jax Mesh, parallel/shard.discover_mesh) spreads each round's
+    single dispatch over the device mesh: the K rung rounds up to mesh
+    divisibility and dispatch_dp_chunk shards the lane axis. Churn is
+    untouched — lanes retire/join at round boundaries exactly as before,
+    and the per-round contiguous repack plus dispatch-side padding IS the
+    shard-local repack (padding lanes are born finished on whichever shard
+    holds them).
     """
     from .. import obs
     from ..align.dp_chunk import (build_lockstep_tables, chunk_plane16,
@@ -111,7 +120,10 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
     from ..graph import POAGraph
     from ..pipeline import _band_cols, _rc_encode
     from . import scheduler
+    from .shard import mesh_size
 
+    S = mesh_size(mesh)
+    occ_route = "sharded" if S > 1 else "lockstep"
     K = len(seq_sets)
     qmax = max((len(s) for ss in seq_sets for s in ss), default=1)
     Qp = qp_rung(qmax)
@@ -186,7 +198,7 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
         active = list(lanes.values())
         occ = len(active) / capacity
         obs.observe("lockstep.noop_set_fraction", 1.0 - occ)
-        scheduler.observe_lane_occupancy(occ)
+        scheduler.observe_lane_occupancy(occ, route=occ_route)
         if occ < 1.0:
             obs.count("lockstep.drain_chunks")
 
@@ -218,7 +230,7 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                 tables.append(build_lockstep_tables(lane.graph, abpt, q, Qp))
             R = plan_row_rung(max(t["n_rows"] for t in tables))
             P = plan_degree_rung(max(t["pre_idx"].shape[1] for t in tables))
-            Kb = k_rung(len(dp_lanes))
+            Kb = k_rung(len(dp_lanes), S)
             plane16 = chunk_plane16(
                 abpt, qmax, max(t["n_rows"] for t in tables))
             # the W-growth retry wraps BOTH dispatches: a band overflow on
@@ -227,7 +239,7 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
             # must never reach fusion
             for _g in range(MAX_W_GROWTH + 1):
                 packed = dispatch_dp_chunk(abpt, tables, Kb, R, P, Qp, W,
-                                           plane16)
+                                           plane16, mesh=mesh)
                 results = [result_from_chunk(
                     abpt, packed[i], tables[i],
                     lane.graph.index_to_node_id) for i, lane in
@@ -266,7 +278,8 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                             t["query"] = query_pad
                             rc_tables.append(t)
                         rc_packed = dispatch_dp_chunk(abpt, rc_tables, Kb,
-                                                      R, P, Qp, W, plane16)
+                                                      R, P, Qp, W, plane16,
+                                                      mesh=mesh)
                         for j, i in enumerate(rc_is):
                             lane = dp_lanes[i]
                             rc_res, rc_f = result_from_chunk(

@@ -117,7 +117,8 @@ def _stamp_rc(tables: dict, abpt: Params, rc_q: np.ndarray) -> dict:
 def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
                     k_cap: Optional[int] = None,
                     hook: Optional[MapHook] = None,
-                    Qp: Optional[int] = None) -> list:
+                    Qp: Optional[int] = None,
+                    mesh=None) -> list:
     """Map `queries` (plus any hook-streamed joiners) against the static
     graph in vmapped pow2 read batches of up to `k_cap` lanes.
 
@@ -125,6 +126,11 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
     initial query, in order. Hook joiners are answered exclusively through
     ``hook.on_retire``. `Qp` pins the group's query rung (serve groups);
     by default it is planned from the longest initial query.
+
+    `mesh` (a jax Mesh) shards each round's single dispatch over the lane
+    mesh: the graph tables replicate, the read batch splits, and the
+    default `k_cap` prices the whole mesh (mesh x the per-chip cap). Join
+    semantics are unchanged — every round boundary is still a join point.
     """
     from .. import obs
     from ..align.dp_chunk import (chunk_plane16, dispatch_dp_chunk,
@@ -134,14 +140,19 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
     from ..obs import metrics
     from ..pipeline import _band_cols, _rc_encode
     from . import scheduler
+    from .shard import mesh_size
 
+    S = mesh_size(mesh)
+    occ_route = "sharded" if S > 1 else "map"
     if Qp is None:
         qmax0 = max((len(q) for q in queries), default=1)
         Qp = qp_rung(qmax0)
     _qp, W, _local = plan_chunk_buckets(abpt, Qp - 2)
     if k_cap is None:
         from .runner import lockstep_group_size
-        k_cap = scheduler.noop_k_cap(lockstep_group_size())
+        per_chip = scheduler.noop_k_cap(lockstep_group_size(),
+                                        route=occ_route)
+        k_cap = per_chip * max(S, 1)
     k_cap = max(1, int(k_cap))
     amb = bool(abpt.amb_strand)
     g = static.graph
@@ -188,7 +199,7 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
         t_round = time.perf_counter()
         obs.count("map.rounds")
         occ = len(lanes) / k_cap
-        scheduler.observe_lane_occupancy(occ)
+        scheduler.observe_lane_occupancy(occ, route=occ_route)
         metrics.publish_map_round(len(lanes), occ)
 
         with obs.phase("align"):
@@ -197,13 +208,13 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
                 obs.record_dp(static.n_rows, _band_cols(abpt, len(q)),
                               abpt.gap_mode)
                 tables.append(static.tables_for(q, Qp))
-            Kb = k_rung(len(lanes))
+            Kb = k_rung(len(lanes), S)
             # W-growth retry wraps BOTH strand dispatches, same contract
             # as the consensus driver: an overflowed result never escapes
             results: list = []
             for _g in range(MAX_W_GROWTH + 1):
                 packed = dispatch_dp_chunk(abpt, tables, Kb, R, P, Qp, W,
-                                           plane16)
+                                           plane16, mesh=mesh)
                 results = [
                     result_from_chunk(abpt, packed[i], tables[i],
                                       static.idx2nid) + ("+",)
@@ -226,7 +237,8 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
                             rc_tables.append(_stamp_rc(tables[i], abpt,
                                                        rc_q))
                         rc_packed = dispatch_dp_chunk(abpt, rc_tables, Kb,
-                                                      R, P, Qp, W, plane16)
+                                                      R, P, Qp, W, plane16,
+                                                      mesh=mesh)
                         for j, i in enumerate(rc_is):
                             rc_res, rc_f = result_from_chunk(
                                 abpt, rc_packed[j], rc_tables[j],

@@ -87,7 +87,7 @@ def _lockstep_ok(abpt: Params) -> bool:
 
 
 def flush_lockstep_group(group: List, abpt: Params, devices: List,
-                         gi: int, impl: str = None) -> dict:
+                         gi: int, impl: str = None, mesh=None) -> dict:
     """Run one lockstep group of (idx, ab, seqs, weights) entries; returns
     {idx: Abpoa-with-finished-graph}. Entries absent from the result
     (whole-batch failure, or a per-set device failure) take the sequential
@@ -98,7 +98,9 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
     impl selects the lockstep implementation (scheduler.lockstep_impl
     when None): "device" = the all-device vmapped fused loop (real
     accelerator mesh), "split" = host fusion + batched banded-DP rounds
-    (parallel/lockstep.py — CPU hosts)."""
+    (parallel/lockstep.py — CPU hosts). `mesh` (split impl only) shards
+    each round's dispatch over a device mesh (the scheduler's "sharded"
+    route)."""
     if not group:
         return {}
     from ..align.fused_loop import (partition_by_length_bucket,
@@ -150,7 +152,7 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
                                 lambda p=piece:
                                 progressive_poa_split_batch(
                                     [e[1] for e in p], [e[2] for e in p],
-                                    abpt)))
+                                    abpt, mesh=mesh)))
                         else:
                             from ..obs import phase
                             with phase("align_fused"):
@@ -197,7 +199,7 @@ def flush_lockstep_group(group: List, abpt: Params, devices: List,
 
 
 def flush_lockstep_group_churn(group: List, abpt: Params, devices: List,
-                               gi: int, churn) -> None:
+                               gi: int, churn, mesh=None) -> None:
     """Continuous-batching variant of flush_lockstep_group (serve-only):
     run one same-rung group of (idx, ab, seqs, weights) entries through
     the SPLIT driver with a round-boundary churn hook. Results are
@@ -229,7 +231,7 @@ def flush_lockstep_group_churn(group: List, abpt: Params, devices: List,
                 "lockstep_batch", backend,
                 lambda: progressive_poa_split_batch(
                     [e[2] for e in group], [e[3] for e in group],
-                    abpt, churn=churn))
+                    abpt, churn=churn, mesh=mesh))
 
 
 def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
@@ -274,8 +276,9 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
             from .pool import run_hybrid_batch
             return run_hybrid_batch(files, abpt, out_fp, route.workers,
                                     route.k_cap)
-    lock = route.kind == "lockstep" if route is not None \
+    lock = route.kind in ("lockstep", "sharded") if route is not None \
         else _lockstep_ok(abpt)
+    mesh = None
     # live batch-progress gauges: `abpoa-tpu top` shows sets done / total
     # while the -l run executes (the exporter flusher publishes them)
     _metrics.publish_batch_progress(0, total=len(files))
@@ -290,6 +293,11 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
                                        warn_unreachable_once)
             if jax_backend_reachable():
                 apply_platform_pin()
+                if route is not None and route.kind == "sharded":
+                    # mesh discovery BEFORE jax.devices(): the virtual
+                    # CPU mesh pin is a no-op once the backend is up
+                    from .shard import discover_mesh
+                    mesh = discover_mesh(route.workers)
                 import jax
                 devices = jax.devices()
             else:
@@ -343,11 +351,17 @@ def run_batch(files: Sequence[str], abpt: Params, out_fp: IO[str],
     def emit_segment() -> None:
         nonlocal gi, K
         results = flush_lockstep_group(group, abpt, devices, gi,
-                                       impl=route.impl if route else None)
+                                       impl=route.impl if route else None,
+                                       mesh=mesh)
         gi += 1
         # divergence feedback: measured noop_set_fraction re-caps the NEXT
-        # segment's group size (scheduler.noop_k_cap)
-        K = scheduler.noop_k_cap(base_K)
+        # segment's group size (scheduler.noop_k_cap) — per route, so the
+        # sharded cap reprices the whole mesh from its own EWMA
+        if route is not None and route.kind == "sharded":
+            K = route.workers * scheduler.noop_k_cap(
+                lockstep_group_size(), route="sharded")
+        else:
+            K = scheduler.noop_k_cap(base_K)
         for idx, fn in seg:
             if idx in results:
                 abpt.batch_index = idx + 1

@@ -1,4 +1,4 @@
-"""ONE scheduler over pool x lockstep x hybrid.
+"""ONE scheduler over pool x lockstep x hybrid x sharded.
 
 Before this module the batch route was ad-hoc: `align/dispatch.py` picked
 kernels, `parallel/runner.py` picked pool-vs-lockstep inline, and
@@ -22,11 +22,20 @@ Routes:
 - **hybrid**    pool-of-lockstep-groups: worker processes each running a
                 split-lockstep group (explicit --workers N on a multicore
                 host with more sets than one group holds)
+- **sharded**   the split driver's one-dispatch-per-round batch spread
+                over an explicit device mesh (--mesh N / ABPOA_TPU_MESH,
+                parallel/shard.py): impl "split" = consensus lockstep,
+                impl "map" = the fixed-graph map stream. K cap = mesh
+                size x the per-chip noop-capped group size, so one chip's
+                worth of divergence feedback scales the whole mesh.
 
 The lockstep K cap is fed back from measured divergence: every split
 round reports its idle-lane fraction (`lockstep.noop_set_fraction`), an
 EWMA of which halves the next groups' K per 0.25 of no-op (divergent-
-length sets stop paying for each other's drain).
+length sets stop paying for each other's drain). The EWMAs are PER ROUTE
+(lockstep / map / sharded): the map stream's zero-barrier occupancy sits
+near 1.0 by construction and must not launder the consensus path's
+drain-tail divergence out of its K cap (nor vice versa).
 """
 from __future__ import annotations
 
@@ -36,22 +45,34 @@ from typing import NamedTuple, Optional
 
 class Route(NamedTuple):
     kind: str       # "serial" | "pool" | "lockstep" | "hybrid" | "map"
-    impl: str       # lockstep implementation: "split" | "device" | ""
-    k_cap: int      # sets per lockstep group (lockstep/hybrid/map)
-    workers: int    # worker processes (pool/hybrid)
+                    # | "sharded"
+    impl: str       # lockstep implementation: "split" | "device" | "map"
+                    # | "" (sharded reuses it for the workload flavour)
+    k_cap: int      # sets per lockstep group (sharded: GLOBAL lanes =
+                    # mesh x per-chip cap)
+    workers: int    # worker processes (pool/hybrid); mesh size (sharded)
     reason: str
 
 
-# EWMA of the measured idle-lane fraction across lockstep rounds/groups of
-# this run (reset per batch); drives the sub-batch K cap
-_NOOP = {"ewma": 0.0, "seen": False}
+# measured-feedback state, PER ROUTE: the idle-lane (noop) EWMA that caps
+# K, and the lane-occupancy estimators the gates compare. Keyed by the
+# observing route so one workload's occupancy cannot inflate (or starve)
+# another's K-cap feedback — the map stream idles ~never while the
+# consensus drain tail idles plenty, and each must see only its own.
+ROUTES = ("lockstep", "map", "sharded")
 NOOP_HALVING_STEP = 0.25
 
-# EWMA of measured lane occupancy (live lanes / group capacity) fed per
-# round by the split driver's lane table; under churn this is the direct
-# gauge of how full the dispatched rung actually is (joins backfill retired
-# lanes, so it stays near 1.0 instead of decaying with the drain)
-_OCC = {"ewma": 1.0, "seen": False, "sum": 0.0, "n": 0}
+
+def _new_noop() -> dict:
+    return {"ewma": 0.0, "seen": False}
+
+
+def _new_occ() -> dict:
+    return {"ewma": 1.0, "seen": False, "sum": 0.0, "n": 0}
+
+
+_NOOP = {r: _new_noop() for r in ROUTES}
+_OCC = {r: _new_occ() for r in ROUTES}
 
 # Below this query length serial wins over lockstep on CPU hosts: the
 # per-round host fusion + dispatch overhead isn't amortized by the tiny DP
@@ -61,57 +82,64 @@ LOCKSTEP_MIN_QLEN = 1500
 
 
 def reset() -> None:
-    _NOOP["ewma"] = 0.0
-    _NOOP["seen"] = False
-    _OCC["ewma"] = 1.0
-    _OCC["seen"] = False
-    _OCC["sum"] = 0.0
-    _OCC["n"] = 0
+    for r in ROUTES:
+        _NOOP[r] = _new_noop()
+        _OCC[r] = _new_occ()
 
 
-def observe_noop_fraction(f: float) -> None:
+def observe_noop_fraction(f: float, route: str = "lockstep") -> None:
     """Fed by the lockstep drivers each round/group; mirrored to the
     `abpoa_lockstep_noop_fraction` gauge so `top` can watch the K-cap
-    heuristic's input live."""
+    heuristic's input live. `route` keys the EWMA: each route's cap reacts
+    only to its own measured divergence."""
     f = min(max(float(f), 0.0), 1.0)
-    _NOOP["ewma"] = f if not _NOOP["seen"] else (
-        0.5 * _NOOP["ewma"] + 0.5 * f)
-    _NOOP["seen"] = True
+    st = _NOOP[route]
+    st["ewma"] = f if not st["seen"] else (0.5 * st["ewma"] + 0.5 * f)
+    st["seen"] = True
     from ..obs import metrics
-    metrics.publish_noop_fraction(_NOOP["ewma"])
+    metrics.publish_noop_fraction(st["ewma"])
 
 
-def noop_ewma() -> float:
-    return _NOOP["ewma"]
+def noop_ewma(route: str = "lockstep") -> float:
+    return _NOOP[route]["ewma"]
 
 
-def observe_lane_occupancy(occ: float) -> None:
+def observe_lane_occupancy(occ: float, route: str = "lockstep") -> None:
     """Fed by the split driver's lane table once per round: live lanes over
     group capacity. Publishes the `abpoa_lockstep_lane_occupancy` gauge and
     feeds the same K-cap EWMA as `observe_noop_fraction` (noop = 1 - occ),
-    so the cap reacts to measured occupancy whether or not churn is on."""
+    so the cap reacts to measured occupancy whether or not churn is on —
+    per `route`, so the map stream's by-construction 1.0 occupancy no
+    longer launders the consensus drain out of the lockstep cap."""
     occ = min(max(float(occ), 0.0), 1.0)
-    _OCC["ewma"] = occ if not _OCC["seen"] else (
-        0.5 * _OCC["ewma"] + 0.5 * occ)
-    _OCC["seen"] = True
-    _OCC["sum"] += occ
-    _OCC["n"] += 1
+    st = _OCC[route]
+    st["ewma"] = occ if not st["seen"] else (0.5 * st["ewma"] + 0.5 * occ)
+    st["seen"] = True
+    st["sum"] += occ
+    st["n"] += 1
     from ..obs import metrics
-    metrics.publish_lane_occupancy(_OCC["ewma"])
-    observe_noop_fraction(1.0 - occ)
+    metrics.publish_lane_occupancy(st["ewma"])
+    observe_noop_fraction(1.0 - occ, route=route)
 
 
-def occupancy_ewma() -> float:
-    return _OCC["ewma"]
+def occupancy_ewma(route: str = "lockstep") -> float:
+    return _OCC[route]["ewma"]
 
 
-def occupancy_mean() -> float:
+def occupancy_mean(route: Optional[str] = None) -> float:
     """Unweighted mean of every per-round occupancy observation since
     reset(). The EWMA's 0.5 blend makes it a recency gauge — it tracks the
     tail of a run, which under churn is always the drain of the last open
     group (no more joiners to backfill). For whole-run comparisons (the
-    churn gate's A/B) the mean is the honest estimator."""
-    return _OCC["sum"] / _OCC["n"] if _OCC["n"] else 1.0
+    churn gate's A/B) the mean is the honest estimator. `route=None`
+    pools every route's observations (the gates' single-workload runs see
+    exactly their own route either way)."""
+    if route is None:
+        total = sum(_OCC[r]["sum"] for r in ROUTES)
+        n = sum(_OCC[r]["n"] for r in ROUTES)
+        return total / n if n else 1.0
+    st = _OCC[route]
+    return st["sum"] / st["n"] if st["n"] else 1.0
 
 
 def lockstep_min_qlen() -> int:
@@ -124,12 +152,15 @@ def lockstep_min_qlen() -> int:
         return LOCKSTEP_MIN_QLEN
 
 
-def noop_k_cap(base_k: int, noop: Optional[float] = None) -> int:
+def noop_k_cap(base_k: int, noop: Optional[float] = None,
+               route: str = "lockstep") -> int:
     """Sub-batch K cap from measured divergence: each NOOP_HALVING_STEP
     (0.25) of idle-lane fraction halves the group, floor 1. At 0.5 noop a
     K=8 group becomes K=2: sets mostly draining alone stop occupying (and
-    waiting on) a wide batch."""
-    f = _NOOP["ewma"] if noop is None else noop
+    waiting on) a wide batch. The feedback is read from `route`'s own
+    EWMA (per-route state — the small-fix regression test pins the
+    isolation)."""
+    f = _NOOP[route]["ewma"] if noop is None else noop
     k = max(1, int(base_k))
     while f >= NOOP_HALVING_STEP and k > 1:
         k //= 2
@@ -161,7 +192,8 @@ def lockstep_impl(abpt) -> str:
 
 def plan_route(abpt, n_sets: int, serve: bool = False,
                qlen: Optional[int] = None,
-               workload: str = "consensus") -> Route:
+               workload: str = "consensus",
+               mesh: Optional[int] = None) -> Route:
     """THE batch/serve dispatch decision: device inventory (accelerator vs
     CPU, core count via pool.resolve_workers), lockstep eligibility
     (config scope + opt-in), and the noop-fraction K cap, in one place.
@@ -179,13 +211,20 @@ def plan_route(abpt, n_sets: int, serve: bool = False,
     crossover nor `_lockstep_ok`'s no-incremental-graph clause applies
     (map BY DEFINITION restores via abpt.incr_fn). The K cap still rides
     the measured-occupancy feedback.
+
+    mesh, when >= 2 (default: the ABPOA_TPU_MESH/--mesh opt-in via
+    shard.requested_mesh_size), upgrades an eligible split-lockstep or
+    map plan to the `sharded` route: the SAME one-dispatch-per-round
+    driver over a device mesh, K cap = mesh x the per-chip noop cap.
     """
     from .runner import _lockstep_ok, lockstep_group_size
+    from .shard import requested_mesh_size
+    mesh_n = requested_mesh_size() if mesh is None else max(0, int(mesh))
     if workload == "map":
-        route = _plan_map(abpt, n_sets, lockstep_group_size)
+        route = _plan_map(abpt, n_sets, lockstep_group_size, mesh_n)
     else:
         route = _plan(abpt, n_sets, serve, _lockstep_ok,
-                      lockstep_group_size, qlen)
+                      lockstep_group_size, qlen, mesh_n)
     from ..obs import count, metrics, trace
     count(f"scheduler.{route.kind}")
     metrics.publish_route(route)
@@ -195,26 +234,33 @@ def plan_route(abpt, n_sets: int, serve: bool = False,
     return route
 
 
-def _plan_map(abpt, n_reads, lockstep_group_size) -> Route:
+def _plan_map(abpt, n_reads, lockstep_group_size, mesh_n: int = 0) -> Route:
     """The map workload's route: batched split-DP rounds whenever a
     jax-family backend is present (the map driver IS the split dispatch
     minus fusion), serial per-read host alignment otherwise. No qlen
-    crossover — a short read costs one round like a long one."""
+    crossover — a short read costs one round like a long one. A >= 2
+    mesh request shards the SAME rounds (kind "sharded", impl "map")."""
     if n_reads <= 0:
         return Route("serial", "", 1, 1, "empty read stream")
     if abpt.device not in ("jax", "tpu", "pallas"):
         return Route("serial", "", 1, 1,
                      f"device {abpt.device!r} has no batched DP chunk")
     base_k = lockstep_group_size()
-    k_cap = noop_k_cap(base_k)
+    if mesh_n >= 2:
+        per_chip = noop_k_cap(base_k, route="sharded")
+        return Route("sharded", "map", mesh_n * per_chip, mesh_n,
+                     f"sharded map K={mesh_n * per_chip} over mesh={mesh_n}"
+                     f" ({mesh_n} x per-chip k_cap {per_chip})")
+    k_cap = noop_k_cap(base_k, route="map")
     reason = f"map split k_cap={k_cap}"
     if k_cap != base_k:
-        reason += f" (noop ewma {_NOOP['ewma']:.2f} capped {base_k})"
+        reason += (f" (noop ewma {_NOOP['map']['ewma']:.2f} "
+                   f"capped {base_k})")
     return Route("map", "split", k_cap, 1, reason)
 
 
 def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
-          qlen=None) -> Route:
+          qlen=None, mesh_n: int = 0) -> Route:
     if n_sets <= 0:
         return Route("serial", "", 1, 1, "empty batch")
     min_q = lockstep_min_qlen()
@@ -235,10 +281,20 @@ def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
                      else "single set/core, or lockstep ineligible")
     impl = lockstep_impl(abpt)
     base_k = lockstep_group_size()
+    if mesh_n >= 2 and impl == "split":
+        # the sharded route IS the split driver over a mesh; the
+        # all-device impl already spans the attached mesh natively, so
+        # only split plans upgrade. The global K cap prices the whole
+        # mesh from one chip's divergence feedback.
+        per_chip = noop_k_cap(base_k, route="sharded")
+        return Route("sharded", "split", mesh_n * per_chip, mesh_n,
+                     f"sharded K={mesh_n * per_chip} over mesh={mesh_n} "
+                     f"({mesh_n} x per-chip k_cap {per_chip})")
     k_cap = noop_k_cap(base_k)
     reason = f"impl={impl} k_cap={k_cap}"
     if k_cap != base_k:
-        reason += f" (noop ewma {_NOOP['ewma']:.2f} capped {base_k})"
+        reason += (f" (noop ewma {_NOOP['lockstep']['ewma']:.2f} "
+                   f"capped {base_k})")
     if not serve and impl == "split":
         w = _explicit_workers(abpt)
         if w > 1 and n_sets > k_cap:

@@ -1,0 +1,324 @@
+"""Sharded DP route: shard_map the lockstep/map batch across a device mesh.
+
+ROADMAP item 2a. The split-lockstep and map drivers already have the
+one-dispatch-per-round data-parallel shape — K independent lanes, one
+vmapped `run_dp_chunk` per round, zero cross-lane collectives — and
+`__graft_entry__.py`'s multichip dryrun proved byte-identical set-, growth-
+and map-batch sharding on a virtual 8-device mesh. This module promotes
+that dryrun pattern into the product path:
+
+- `discover_mesh`: `jax.devices()` grouped by platform (real silicon
+  preferred over the host cpu platform), sized by `ABPOA_TPU_MESH` /
+  `--mesh N`. 1-core hosts get the `--xla_force_host_platform_device_count`
+  virtual mesh ONLY on that explicit request (`pin_virtual_cpu_mesh`,
+  promoted from the dryrun, must run before the first backend init).
+- `shard_dp_round`: the sharded twin of `align.dp_chunk.dispatch_dp_chunk`
+  — pad/stack K lane tables exactly as the unsharded dispatch does, then
+  reshape the lane axis (K,) -> (mesh, K/mesh) and run ONE
+  `shard_map(jax.vmap(run_dp_chunk))` over the 1-axis lane mesh. Graph
+  scoring constants (`mat`, gap penalties) replicate into every shard
+  (the dryrun phase-4 pattern: `StaticGraphTables` replicated, reads
+  sharded); per-shard K stays on the pow2 rung chain, so global
+  K = mesh x per-shard rung and padding lanes are born finished
+  (n_rows=2/qlen=0) just like the unsharded path.
+- `shard_vmap`: the `shard_map(jax.vmap(f))` spec boilerplate the dryrun
+  phases used to repeat inline, in one place.
+
+Byte parity falls out of construction: each shard computes the same
+vmapped `run_dp_chunk` lanes the unsharded dispatch would, on a disjoint
+contiguous slice of the lane axis — `tools/shard_gate.py` pins it against
+the unsharded driver AND the numpy oracle, with churn joins in flight.
+
+jax is imported lazily throughout: `abpoa_tpu.parallel` must stay
+importable on host-only paths that never pay a jax import (runner.py's
+contract).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from ..compile import registry
+from ..params import Params
+
+# the 1-axis lane mesh axis name — the same axis the multichip dryrun and
+# runner.shard_dp_batch shard over (data parallelism over lanes/sets)
+AXIS = "set"
+
+
+def pin_virtual_cpu_mesh(n_devices: int) -> None:
+    """Force the CPU platform with >= n_devices virtual devices BEFORE any
+    backend initialization. The environment may preset JAX_PLATFORMS to a
+    real accelerator tunnel (axon); merely overriding the env var is not
+    enough once the site hook has read it, so pin via jax.config (same
+    approach as tests/conftest.py). Idempotent: an existing larger
+    `--xla_force_host_platform_device_count` wins."""
+    import jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    kept = [f for f in flags.split()
+            if not re.match(r"--xla_force_host_platform_device_count=", f)]
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n_devices, int(m.group(1)) if m else 0)
+    kept.append(f"--xla_force_host_platform_device_count={count}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def requested_mesh_size(cli: Optional[int] = None) -> int:
+    """The operator's mesh request: an explicit CLI value wins, else the
+    ABPOA_TPU_MESH env var. 0 or 1 (or unset/garbage) means OFF — the
+    sharded route is strictly opt-in, and a 1-device "mesh" is just the
+    unsharded dispatch with extra steps."""
+    if cli is not None:
+        return max(0, int(cli))
+    raw = os.environ.get("ABPOA_TPU_MESH", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+def mesh_size(mesh) -> int:
+    """Lane-mesh width; 1 for the unsharded path (mesh=None)."""
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def discover_mesh(n: Optional[int] = None):
+    """Build the 1-axis lane Mesh of `n` devices (default: the
+    `requested_mesh_size()` opt-in; < 2 returns None — no mesh).
+
+    Devices are grouped by platform and real silicon is preferred over the
+    host cpu platform. A CPU-pinned host (JAX_PLATFORMS=cpu) gets the
+    `--xla_force_host_platform_device_count` virtual mesh — only here,
+    under an explicit size request, and only if the pin lands before the
+    first backend initialization. Raises RuntimeError when no platform
+    group is wide enough."""
+    size = requested_mesh_size() if n is None else max(0, int(n))
+    if size < 2:
+        return None
+    plat = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0]
+    if plat.strip().lower() == "cpu":
+        # the explicit size request on a CPU host IS the virtual-mesh
+        # opt-in; a no-op if the backend already initialized with enough
+        # virtual devices (tests/conftest.py pins 8 the same way)
+        pin_virtual_cpu_mesh(size)
+    import jax
+    from jax.sharding import Mesh
+    groups: dict = {}
+    for d in jax.devices():
+        groups.setdefault(d.platform, []).append(d)
+    for _plat, devs in sorted(groups.items(), key=lambda kv: kv[0] == "cpu"):
+        if len(devs) >= size:
+            return Mesh(np.array(devs[:size]), axis_names=(AXIS,))
+    have = {p: len(d) for p, d in groups.items()}
+    raise RuntimeError(
+        f"mesh of {size} devices requested but the attached platform "
+        f"groups are {have}; on a 1-core host export JAX_PLATFORMS=cpu so "
+        "the --xla_force_host_platform_device_count virtual mesh can be "
+        "pinned (it must land before the first jax backend initialization)")
+
+
+def shard_vmap(f, mesh, n_shard: int, n_rep: int = 0):
+    """`shard_map(jax.vmap(f))` over the 1-axis lane mesh: the first
+    `n_shard` args shard on their leading (mesh-sized) axis, the trailing
+    `n_rep` args replicate into every shard — ONE definition of the spec
+    boilerplate the multichip dryrun phases used to repeat inline."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..utils.jaxcompat import shard_map
+    vf = jax.vmap(f, in_axes=(0,) * n_shard + (None,) * n_rep) \
+        if n_rep else jax.vmap(f)
+    return shard_map(vf, mesh=mesh,
+                     in_specs=(P(AXIS),) * n_shard + (P(),) * n_rep,
+                     out_specs=P(AXIS))
+
+
+# --------------------------------------------------------------------------- #
+# the sharded dispatch: shard_map(vmap(run_dp_chunk)) over the lane mesh      #
+# --------------------------------------------------------------------------- #
+
+# per-lane args (sharded, leading axes (mesh, K/mesh)) and replicated
+# scoring args — the exact run_dp_chunk signature split
+_N_LANE = 16     # len(_TABLE_KEYS) + len(_SCALAR_KEYS)
+_N_SHARED = 9    # mat, inf_min, o1, e1, oe1, o2, e2, oe2, zdrop
+
+_SHARDED_JIT = None
+
+
+def _sharded_jit():
+    """The ONE stable jitted sharded entry (built lazily so importing this
+    module never pays a jax import). `jax.sharding.Mesh` is hashable, so
+    the mesh rides as a static argname: every (mesh, statics) signature
+    compiles once and `obs.compile_log.compile_watch` gets a real
+    `_cache_size` handle for ground-truth miss detection."""
+    global _SHARDED_JIT
+    if _SHARDED_JIT is not None:
+        return _SHARDED_JIT
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=(
+        "mesh", "gap_mode", "W", "max_ops", "plane16", "extend", "zdrop_on",
+        "local", "gap_on_right", "put_gap_at_end"))
+    def run_dp_chunk_sharded(*args, mesh, gap_mode, W, max_ops, plane16,
+                             extend, zdrop_on, local, gap_on_right,
+                             put_gap_at_end):
+        from ..align.dp_chunk import run_dp_chunk
+
+        def slot(*a):
+            # one mesh slot: the unsharded vmapped chunk over its K/mesh
+            # lane slice, scoring constants replicated by spec
+            return run_dp_chunk(
+                *a, gap_mode=gap_mode, W=W, max_ops=max_ops,
+                plane16=plane16, extend=extend, zdrop_on=zdrop_on,
+                local=local, gap_on_right=gap_on_right,
+                put_gap_at_end=put_gap_at_end)
+
+        return shard_vmap(slot, mesh, _N_LANE, _N_SHARED)(*args)
+
+    _SHARDED_JIT = run_dp_chunk_sharded
+    return _SHARDED_JIT
+
+
+def shard_dp_round(abpt: Params, table_list: List[dict], Kb: int, R: int,
+                   P: int, Qp: int, W: int, plane16: bool,
+                   mesh) -> np.ndarray:
+    """Sharded twin of `align.dp_chunk.dispatch_dp_chunk`: pad `table_list`
+    to the shared (R, P) rungs and Kb lane slots exactly as the unsharded
+    dispatch does, reshape the lane axis (Kb,) -> (mesh, Kb/mesh), and run
+    ONE shard_map(vmap(run_dp_chunk)) round. Padding lanes are born
+    finished (n_rows=2/qlen=0); contiguous packing means they land in the
+    trailing shards, whose lanes no-op — shard-local repack is just the
+    host repacking the lane list before the reshape, same as unsharded."""
+    import jax.numpy as jnp
+    from ..align.dp_chunk import (_SCALAR_KEYS, _TABLE_KEYS, _pad_tables,
+                                  chunk_statics)
+    from ..align.oracle import INT16_MIN, INT32_MIN, dp_inf_min
+    from ..obs import metrics, trace
+
+    S = mesh_size(mesh)
+    if S < 2:
+        raise ValueError("shard_dp_round needs a >=2-device mesh "
+                         "(use dispatch_dp_chunk for the unsharded path)")
+    if Kb % S:
+        raise ValueError(
+            f"sharded dispatch: K rung {Kb} is not divisible by the mesh "
+            f"size {S} (k_rung(k, mesh_size) plans divisible rungs)")
+    k_per = Kb // S
+    max_ops = R + Qp + 8
+    k_real = len(table_list)
+    padded = [_pad_tables(t, R, P) for t in table_list]
+    lane_args = []
+    for key in _TABLE_KEYS:
+        stacked = np.stack([t[key] for t in padded])
+        if k_real < Kb:
+            pad = np.zeros((Kb - k_real,) + stacked.shape[1:],
+                           stacked.dtype)
+            stacked = np.concatenate([stacked, pad])
+        lane_args.append(jnp.asarray(
+            stacked.reshape((S, k_per) + stacked.shape[1:])))
+    for key in _SCALAR_KEYS:
+        vec = np.asarray([t[key] for t in table_list], np.int32)
+        if k_real < Kb:
+            fill = 2 if key == "n_rows" else 0
+            vec = np.concatenate([vec,
+                                  np.full(Kb - k_real, fill, np.int32)])
+        lane_args.append(jnp.asarray(vec.reshape(S, k_per)))
+    inf_min = dp_inf_min(abpt, INT16_MIN if plane16 else INT32_MIN)
+    mat = jnp.asarray(np.ascontiguousarray(abpt.mat.astype(np.int32)))
+    shared = (mat, jnp.int32(inf_min),
+              jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+              jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+              jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
+              jnp.int32(max(abpt.zdrop, 0)))
+    statics = chunk_statics(abpt, W, max_ops, plane16)
+    # the bucket names the PER-SHARD shape (K = lanes each device runs)
+    # plus the mesh axis — global lanes = K x mesh, the ladder's declared
+    # sharded rung grammar
+    bucket = dict(R=R, P=P, Qp=Qp, W=W, K=k_per, mesh=S, plane16=plane16,
+                  gap_mode=abpt.gap_mode, align_mode=abpt.align_mode)
+    metrics.publish_mesh(S, mesh.devices.flat[0].platform)
+    for i in range(S):
+        live = min(max(k_real - i * k_per, 0), k_per)
+        metrics.publish_shard_occupancy(i, live / k_per)
+    with trace.span("dp_chunk", "dp", args=dict(bucket, sets=k_real)):
+        with registry.watch("run_dp_chunk[sharded]", bucket):
+            packed = _sharded_jit()(*lane_args, *shared, mesh=mesh,
+                                    **statics)
+            out = np.asarray(packed)  # sync inside the compile bracket
+    return out.reshape((Kb,) + out.shape[2:])[:k_real]
+
+
+# --------------------------------------------------------------------------- #
+# compile-ladder integration: AOT warmer for the sharded rungs                #
+# --------------------------------------------------------------------------- #
+
+def _warm_dp_chunk_sharded(abpt: Params, anchor) -> list:
+    """Precompile the sharded DP chunk for one anchor at the OPERATOR'S
+    requested mesh width (the shapes runs will actually dispatch); with no
+    mesh requested the anchor is skipped — sharding is opt-in, and warming
+    mesh shapes a host can't build would fail the warm pass. Per-shard K
+    halvings mirror `_warm_dp_chunk`'s repack chain: global K = mesh x
+    per-shard pow2 rung, down to one lane per shard (the drain floor)."""
+    from ..align.dp_chunk import P_FLOOR, plan_row_rung
+    from ..align.oracle import int16_score_limit, max_score_bound
+    from ..compile.ladder import k_rung, plan_chunk_buckets, qp_rung
+    from ..obs import compile_log
+    S = requested_mesh_size()
+    if S < 2:
+        return [{"entry": anchor.entry, "skipped": "no mesh requested"}]
+    try:
+        mesh = discover_mesh(S)
+    except RuntimeError as e:
+        return [{"entry": anchor.entry, "skipped": str(e)}]
+    recs = []
+    Qp = qp_rung(anchor.qmax)
+    _qp, W, _local = plan_chunk_buckets(abpt, anchor.qmax)
+    plane16 = (max_score_bound(abpt, anchor.qmax, 2)
+               <= int16_score_limit(abpt))
+    ks = []
+    k = k_rung(anchor.k or 2)
+    while k >= 1:
+        ks.append(k)
+        k //= 2
+    rungs = []
+    R = plan_row_rung(anchor.qmax + 2)
+    stop = plan_row_rung(2 * (anchor.qmax + 2) + 64)
+    for _g in range(anchor.growth + 1):
+        rungs.append(R)
+        if R >= stop:
+            break
+        R = plan_row_rung(R + 1)
+    for R in rungs:
+        for k_per in ks:
+            Kb = S * k_per
+            tables = [dict(
+                base_r=np.zeros(R, np.int32),
+                pre_idx=np.zeros((R, P_FLOOR), np.int32),
+                pre_msk=np.zeros((R, P_FLOOR), bool),
+                out_idx=np.zeros((R, P_FLOOR), np.int32),
+                out_msk=np.zeros((R, P_FLOOR), bool),
+                row_active=np.zeros(R, bool),
+                remain_rows=np.zeros(R, np.int32),
+                mpl0=np.zeros(R, np.int32), mpr0=np.zeros(R, np.int32),
+                qp=np.zeros((abpt.m, Qp), np.int32),
+                query=np.zeros(Qp, np.int32),
+                n_rows=2, qlen=0, w=0, remain_end=0, dp_end0=0)] * Kb
+            shard_dp_round(abpt, tables, Kb, R, P_FLOOR, Qp, W, plane16,
+                           mesh)
+            rr = compile_log.run_records()
+            recs.append(
+                rr[-1] if rr and rr[-1]["fn"] == "run_dp_chunk[sharded]"
+                else {"fn": "run_dp_chunk[sharded]",
+                      "bucket": dict(R=R, K=k_per, mesh=S, Qp=Qp, W=W)})
+    return recs
+
+
+registry.register_entry("run_dp_chunk[sharded]", handle=_sharded_jit,
+                        warmer=_warm_dp_chunk_sharded)

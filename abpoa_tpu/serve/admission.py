@@ -174,13 +174,19 @@ class AdmissionController:
     variable; every mutation republishes the queue/inflight gauges."""
 
     def __init__(self, abpt, max_depth: Optional[int] = None,
-                 budget_bytes: Optional[int] = None) -> None:
+                 budget_bytes: Optional[int] = None,
+                 mesh: int = 1) -> None:
         self._abpt = abpt
         self._cv = threading.Condition()
         self._queue: Deque[Job] = deque()
         self._max_depth = max_depth if max_depth is not None else queue_limit()
         self._budget = (budget_bytes if budget_bytes is not None
                         else serve_budget_bytes())
+        # sharded route: the byte gate prices the WHOLE mesh — each of the
+        # mesh's devices holds only its K/mesh lane slice of the planes, so
+        # the per-device budget scales to mesh x budget globally
+        if self._budget and mesh > 1:
+            self._budget *= int(mesh)
         self._bytes = 0          # queued + in-flight estimate
         self._inflight = 0
         self._closed = False

@@ -446,7 +446,8 @@ class AlignServer:
                  deadline_s: Optional[float] = None,
                  pool_workers: Optional[int] = None,
                  trace_dir: Optional[str] = None,
-                 map_graph: Optional[str] = None) -> None:
+                 map_graph: Optional[str] = None,
+                 mesh: Optional[int] = None) -> None:
         if not abpt._finalized:
             abpt = abpt.finalize()
         self.abpt = abpt
@@ -458,7 +459,15 @@ class AlignServer:
             "ABPOA_TPU_SERVE_TRACE_DIR") or None
         self.deadline_s = (deadline_s if deadline_s is not None
                            else default_deadline_s())
-        self.admission = AdmissionController(abpt, max_depth=queue_depth)
+        # sharded route (PR 19): --mesh N / ABPOA_TPU_MESH spreads each
+        # coalesced group's per-round dispatch over an N-device mesh; the
+        # admission byte gate prices the whole mesh (each device holds
+        # only its lane slice), and /healthz advertises the mesh shape
+        from ..parallel.shard import requested_mesh_size
+        self._mesh_req = requested_mesh_size(mesh)
+        self._mesh = None           # jax Mesh, discovered in start()
+        self.admission = AdmissionController(abpt, max_depth=queue_depth,
+                                             mesh=max(self._mesh_req, 1))
         # process-isolated execution backend (parallel/pool.py): requests
         # run in supervised worker PROCESSES — a native crash or wedged
         # dispatch costs one job's process, never a serve worker thread.
@@ -530,6 +539,17 @@ class AlignServer:
             from ..utils.probe import apply_platform_pin, jax_backend_reachable
             if jax_backend_reachable():
                 apply_platform_pin()
+                if self._mesh_req >= 2:
+                    # mesh discovery BEFORE warm/jax.devices(): the virtual
+                    # CPU mesh pin must land before backend init. An
+                    # unbuildable requested mesh is a startup error, not a
+                    # silent unsharded fallback.
+                    from ..obs import metrics as _metrics
+                    from ..parallel.shard import discover_mesh
+                    self._mesh = discover_mesh(self._mesh_req)
+                    _metrics.publish_mesh(
+                        self._mesh_req,
+                        self._mesh.devices.flat[0].platform)
                 if warm != "off":
                     from ..compile import warm_ladder
                     t0 = time.perf_counter()
@@ -548,8 +568,8 @@ class AlignServer:
                 # implementation runs them (parallel/scheduler.py)
                 from ..parallel import lockstep_group_size, plan_route
                 route = plan_route(self.abpt, lockstep_group_size(),
-                                   serve=True)
-                self._lockstep = route.kind == "lockstep"
+                                   serve=True, mesh=self._mesh_req)
+                self._lockstep = route.kind in ("lockstep", "sharded")
                 self._lockstep_impl = route.impl
                 # churn needs the split driver's host-side round
                 # boundaries (the all-device loop has none to board at)
@@ -567,8 +587,9 @@ class AlignServer:
             t0 = time.perf_counter()
             _ab, self._map_static = load_static_graph(self._map_graph,
                                                       self.abpt)
-            route = plan_route(self.abpt, 1, workload="map")
-            self._map_coalesce = route.kind == "map"
+            route = plan_route(self.abpt, 1, workload="map",
+                               mesh=self._mesh_req)
+            self._map_coalesce = route.kind in ("map", "sharded")
             print(f"[abpoa-tpu serve] map graph {self._map_graph}: "
                   f"{self._map_static.n_rows - 2} nodes restored in "
                   f"{time.perf_counter() - t0:.1f}s "
@@ -638,6 +659,14 @@ class AlignServer:
         slo` offenders and `abpoa-tpu why` resolve."""
         self.bump(status, job.wall_s())
         rec = _request_record(job, status, self.abpt.device)
+        if self._mesh is not None:
+            # `why` renders "sharded K=<cap> over mesh=<n>" from these
+            rec["mesh"] = int(self._mesh.devices.size)
+            rec["route"] = "sharded"
+            from ..parallel import lockstep_group_size
+            rec["k_cap"] = self._sharded_k_cap(
+                lockstep_group_size(),
+                "map" if job.kind == "map" else "lockstep")
         rec["request_id"] = job.rid or None
         if job.dumps:
             rec["dump_file"] = job.dumps[-1]
@@ -713,6 +742,12 @@ class AlignServer:
             out["map_graph"] = {"path": self._map_graph,
                                 "nodes": self._map_static.n_rows - 2,
                                 "batched": self._map_coalesce}
+        if self._mesh is not None:
+            # the fleet router's capacity signal: a sharded replica's
+            # group K-caps (and its byte budget) span the whole mesh
+            out["mesh"] = {"devices": int(self._mesh.devices.size),
+                           "platform": self._mesh.devices.flat[0].platform,
+                           "axis": "set"}
         return out
 
     # ------------------------------------------------- open-group registry
@@ -734,17 +769,30 @@ class AlignServer:
             return [dict(g) for g in self._open_groups.values()]
 
     # ---------------------------------------------------------- execution
+    def _sharded_k_cap(self, base_k: int, route: str) -> int:
+        """The coalesced group's K cap: the per-chip noop cap, scaled to
+        the whole mesh under the sharded route (per-route feedback:
+        scheduler state is keyed by the observing route)."""
+        from ..parallel import scheduler as _sched
+        if self._mesh is not None:
+            return (int(self._mesh.devices.size)
+                    * _sched.noop_k_cap(base_k, route="sharded"))
+        return _sched.noop_k_cap(base_k, route=route)
+
     def _worker_loop(self) -> None:
         from ..parallel import lockstep_group_size
         from ..parallel import scheduler as _sched
         coalescing = self._lockstep or self._map_coalesce
         base_k = lockstep_group_size() if coalescing else 1
+        route = "map" if (self._map_coalesce
+                          and not self._lockstep) else "lockstep"
         while True:
             # divergence feedback: measured noop_set_fraction re-caps the
             # next coalesced group's K (scheduler.noop_k_cap). Groups are
             # kind-homogeneous (next_group filters on head.kind), so one
             # loop serves both /align and /map pickups.
-            max_k = (_sched.noop_k_cap(base_k) if coalescing else 1)
+            max_k = (self._sharded_k_cap(base_k, route)
+                     if coalescing else 1)
             group = self.admission.next_group(
                 max_k=max_k, coalesce=coalescing,
                 min_qlen=(_sched.lockstep_min_qlen()
@@ -984,7 +1032,7 @@ class AlignServer:
             results = call_with_deadline(
                 lambda: flush_lockstep_group(
                     entries, abpt, self._devices, gi,
-                    impl=self._lockstep_impl or None),
+                    impl=self._lockstep_impl or None, mesh=self._mesh),
                 deadline_s=deadline, label=f"serve_group:{gi}")
         except DispatchTimeout:
             for i, *_ in entries:
@@ -1036,9 +1084,9 @@ class AlignServer:
         entries = []
         gi = next(self._group_ids)
         from ..parallel import lockstep_group_size
-        from ..parallel import scheduler as _sched
         hook = _ServeChurnHook(self, abpt, gi, jobs[0].rung,
-                               _sched.noop_k_cap(lockstep_group_size()))
+                               self._sharded_k_cap(lockstep_group_size(),
+                                                   "lockstep"))
         for i, job in enumerate(jobs):
             try:
                 ab = Abpoa()
@@ -1057,7 +1105,7 @@ class AlignServer:
                                 hook.k_cap - len(entries), 0, len(entries))
         try:
             flush_lockstep_group_churn(entries, abpt, self._devices, gi,
-                                       hook)
+                                       hook, mesh=self._mesh)
         except (DispatchFailed, RuntimeError) as e:
             print(f"Warning: churn lockstep group {gi} failed ({e}); "
                   "sweeping members to the sequential path.",
@@ -1087,7 +1135,6 @@ class AlignServer:
         read retires and claims queued same-rung /map requests onto freed
         lanes — every round, because every map lane frees every round."""
         from ..parallel import lockstep_group_size, map_reads_split
-        from ..parallel import scheduler as _sched
         from ..resilience import DispatchFailed
         if not self._map_coalesce:
             # host route (no batched DP backend): per-read oracle, one
@@ -1100,14 +1147,16 @@ class AlignServer:
             return
         gid = next(self._group_ids)
         hook = _ServeMapHook(self, abpt, gid, jobs[0].rung,
-                             _sched.noop_k_cap(lockstep_group_size()))
+                             self._sharded_k_cap(lockstep_group_size(),
+                                                 "map"))
         for job in jobs:
             hook.add_job(job)
         self._open_group_update(gid, hook.rung, hook.k_cap, 0, 0,
                                 kind="map")
         try:
             map_reads_split(self._map_static, [], abpt,
-                            k_cap=hook.k_cap, hook=hook, Qp=hook.rung)
+                            k_cap=hook.k_cap, hook=hook, Qp=hook.rung,
+                            mesh=self._mesh)
         except (DispatchFailed, RuntimeError) as e:
             print(f"Warning: map group {gid} failed ({e}); sweeping "
                   "members to the host path.", file=sys.stderr)
@@ -1466,6 +1515,12 @@ def _build_parser() -> argparse.ArgumentParser:
                     choices=["auto", "on", "off"],
                     help="coalesce same-rung requests into vmapped "
                          "lockstep dispatches [auto: accelerator only]")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="shard each coalesced group's per-round dispatch "
+                         "over an N-device lane mesh (the sharded route; "
+                         "K caps and the admission byte gate scale by N; "
+                         "1-core hosts get the virtual CPU mesh only on "
+                         "this explicit request) [ABPOA_TPU_MESH]")
     ap.add_argument("-m", "--aln-mode", type=int, default=C.GLOBAL_MODE)
     ap.add_argument("-M", "--match", type=int, default=C.DEFAULT_MATCH)
     ap.add_argument("-X", "--mismatch", type=int, default=C.DEFAULT_MISMATCH)
@@ -1522,7 +1577,8 @@ def serve_main(argv) -> int:
                              deadline_s=args.deadline_s,
                              pool_workers=args.pool_workers,
                              trace_dir=args.trace_dir,
-                             map_graph=args.map_graph)
+                             map_graph=args.map_graph,
+                             mesh=args.mesh)
     except OSError as e:
         print(f"Error: cannot bind {args.host}:{args.port}: {e}",
               file=sys.stderr)

@@ -111,6 +111,47 @@ def test_planner_requests_are_on_ladder():
         assert k_rung(n, 8) % 8 == 0
 
 
+def test_sharded_planner_requests_are_on_ladder():
+    """Property (PR 19): every sharded bucket axis `plan_route` + the
+    sharded dispatch can request — per-shard K halvings under the noop
+    cap, mesh widths, the row/degree growth rungs, Qp/W — is a declared
+    rung of the run_dp_chunk[sharded] ladder entry, so `warm` can always
+    precompile what a sharded run will dispatch."""
+    from abpoa_tpu.align.dp_chunk import plan_degree_rung, plan_row_rung
+    from abpoa_tpu.compile.ladder import (k_rung, mesh_rung, on_ladder,
+                                          plan_chunk_buckets, qp_rung)
+    from abpoa_tpu.parallel import scheduler
+    abpt = _params("numpy")
+    E = "run_dp_chunk[sharded]"
+    rng = np.random.default_rng(19)
+    for mesh_n in (2, 4, 8, 16, 64, 256):
+        assert on_ladder(E, "mesh", mesh_rung(mesh_n)), mesh_n
+        # the scheduler's per-chip cap chain: base 8 halved by the noop
+        # EWMA down to the drain floor of 1 lane per shard
+        for noop in (0.0, 0.3, 0.6, 0.9, 1.0):
+            per_chip = scheduler.noop_k_cap(8, noop=noop, route="sharded")
+            assert on_ladder(E, "K", per_chip), (noop, per_chip)
+            # pow2 mesh keeps the mesh-divisible global rung's per-shard
+            # slice on the declared chain
+            kb = k_rung(mesh_n * per_chip, mesh_n)
+            assert kb % mesh_n == 0
+            assert on_ladder(E, "K", kb // mesh_n), (mesh_n, per_chip, kb)
+    for qmax in [60, 300, 2200, 9999] + [
+            int(x) for x in rng.integers(1, 60_000, 60)]:
+        Qp, W, _ = plan_chunk_buckets(abpt, qmax)
+        assert on_ladder(E, "Qp", Qp) and on_ladder(E, "Qp", qp_rung(qmax))
+        assert on_ladder(E, "W", W), (qmax, W)
+        R = plan_row_rung(qmax + 2)
+        stop = plan_row_rung(2 * (qmax + 2) + 64)
+        for _ in range(6):
+            assert on_ladder(E, "R", R), (qmax, R)
+            if R >= stop:
+                break
+            R = plan_row_rung(R + 1)
+    for d in (1, 2, 5, 8, 30):
+        assert on_ladder(E, "P", plan_degree_rung(d))
+
+
 def test_window_planner_on_ladder():
     """The seeded-window batch planner's R/Qp/degree axes are declared."""
     from abpoa_tpu.compile.buckets import bucket, bucket_pow2
@@ -128,14 +169,19 @@ def test_rungs_raise_past_declared_caps():
     """Beyond the declared chain caps the rung helpers RAISE (clear error
     naming the cap) instead of silently producing an off-ladder shape the
     warmer could never precompile."""
-    from abpoa_tpu.compile.ladder import (GEOM_128, POW2_READS, qp_rung,
-                                          reads_rung)
+    from abpoa_tpu.compile.ladder import (GEOM_128, MESH, POW2_READS,
+                                          mesh_rung, qp_rung, reads_rung)
     assert reads_rung(20000) in POW2_READS
     assert qp_rung(200_000) in GEOM_128
+    assert mesh_rung(256) in MESH
     with pytest.raises(ValueError, match="beyond the declared ladder cap"):
         reads_rung((1 << 17) + 1)
     with pytest.raises(ValueError, match="beyond the declared ladder cap"):
         qp_rung(1 << 19)
+    # a mesh wider than the declared 256-device chain must RAISE, not
+    # silently compile an off-ladder mesh shape (PR 19 cap-raise test)
+    with pytest.raises(ValueError, match="beyond the declared ladder cap"):
+        mesh_rung(512)
 
 
 def test_qmax_interval_roundtrip():

@@ -1,0 +1,340 @@
+"""Sharded-route tests (PR 19, ROADMAP item 2a).
+
+- mesh plumbing units: the ABPOA_TPU_MESH/--mesh request grammar, the
+  virtual-CPU-mesh XLA flag rewrite, mesh_size, and shard_dp_round's
+  shape guards
+- scheduler: the `sharded` route (consensus + map flavours), its
+  mesh x per-chip K cap, and the per-route occupancy/noop isolation
+  regression (the map stream's ~1.0 occupancy must not launder the
+  consensus drain out of the lockstep cap)
+- promoted multichip dryrun phases (__graft_entry__.dryrun_multichip
+  keeps running them end-to-end; these are the pytest-owned versions):
+  phase 1 (independent fused read-set alignments shard_vmapped over the
+  mesh) and phase 4 (one static graph, the read batch sharded across
+  the mesh, byte-equal to the unsharded dispatch AND the host oracle)
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from abpoa_tpu.params import Params  # noqa: E402
+
+
+def _params(device="jax", **kw):
+    abpt = Params()
+    abpt.device = device
+    for k, v in kw.items():
+        setattr(abpt, k, v)
+    abpt.finalize()
+    return abpt
+
+
+# --------------------------------------------------------------------- #
+# mesh request grammar + virtual mesh pin                               #
+# --------------------------------------------------------------------- #
+
+def test_requested_mesh_size_parsing(monkeypatch):
+    from abpoa_tpu.parallel.shard import requested_mesh_size
+    monkeypatch.delenv("ABPOA_TPU_MESH", raising=False)
+    assert requested_mesh_size() == 0
+    monkeypatch.setenv("ABPOA_TPU_MESH", "8")
+    assert requested_mesh_size() == 8
+    monkeypatch.setenv("ABPOA_TPU_MESH", "0")
+    assert requested_mesh_size() == 0
+    monkeypatch.setenv("ABPOA_TPU_MESH", "garbage")
+    assert requested_mesh_size() == 0
+    monkeypatch.setenv("ABPOA_TPU_MESH", "-3")
+    assert requested_mesh_size() == 0
+    # an explicit CLI value wins over the env var
+    assert requested_mesh_size(cli=4) == 4
+    assert requested_mesh_size(cli=0) == 0
+
+
+def test_pin_virtual_cpu_mesh_flag_rewrite(monkeypatch):
+    """The promoted dryrun pin: max-wins on the existing device-count flag,
+    other XLA flags preserved, platform forced to cpu."""
+    from abpoa_tpu.parallel.shard import pin_virtual_cpu_mesh
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_cpu_foo=1 --xla_force_host_platform_device_count=4")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    pin_virtual_cpu_mesh(8)
+    flags = os.environ["XLA_FLAGS"]
+    assert "--xla_cpu_foo=1" in flags
+    assert flags.count("--xla_force_host_platform_device_count=") == 1
+    assert "--xla_force_host_platform_device_count=8" in flags
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    # idempotent, and an existing LARGER count wins (never shrink a mesh
+    # another component already pinned)
+    pin_virtual_cpu_mesh(2)
+    assert ("--xla_force_host_platform_device_count=8"
+            in os.environ["XLA_FLAGS"])
+
+
+def test_mesh_size_and_discovery():
+    from abpoa_tpu.parallel.shard import discover_mesh, mesh_size
+    assert mesh_size(None) == 1
+    # < 2 is OFF, not a 1-device mesh
+    assert discover_mesh(0) is None
+    assert discover_mesh(1) is None
+    # conftest pins the virtual 8-device CPU mesh before jax init
+    mesh = discover_mesh(2)
+    assert mesh is not None and mesh_size(mesh) == 2
+    assert mesh.axis_names == ("set",)
+    with pytest.raises(RuntimeError, match="mesh of 4096 devices"):
+        discover_mesh(4096)
+
+
+def test_shard_dp_round_shape_guards():
+    from abpoa_tpu.parallel.shard import discover_mesh, shard_dp_round
+    abpt = _params("jax")
+    with pytest.raises(ValueError, match="needs a >=2-device mesh"):
+        shard_dp_round(abpt, [], 8, 64, 8, 128, 64, True, None)
+    mesh = discover_mesh(2)
+    with pytest.raises(ValueError, match="not divisible by the mesh"):
+        shard_dp_round(abpt, [], 3, 64, 8, 128, 64, True, mesh)
+
+
+# --------------------------------------------------------------------- #
+# scheduler: the sharded route + per-route feedback isolation           #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture
+def sched_env(monkeypatch):
+    from abpoa_tpu.parallel import scheduler
+    monkeypatch.delenv("ABPOA_TPU_LOCKSTEP_K", raising=False)
+    monkeypatch.delenv("ABPOA_TPU_LOCKSTEP_IMPL", raising=False)
+    monkeypatch.setenv("ABPOA_TPU_LOCKSTEP", "1")
+    scheduler.reset()
+    yield scheduler
+    scheduler.reset()
+
+
+def test_plan_route_sharded_consensus(sched_env, monkeypatch):
+    from abpoa_tpu.parallel.scheduler import plan_route
+    monkeypatch.setenv("ABPOA_TPU_MESH", "8")
+    abpt = _params("jax")
+    route = plan_route(abpt, 16)
+    assert route.kind == "sharded" and route.impl == "split"
+    assert route.workers == 8
+    # global K cap prices the whole mesh: mesh x per-chip noop cap (8 x 8)
+    assert route.k_cap == 64
+    assert "sharded K=64 over mesh=8" in route.reason
+    # an explicit mesh=0 argument turns the upgrade off
+    route = plan_route(abpt, 16, mesh=0)
+    assert route.kind == "lockstep" and route.impl == "split"
+
+
+def test_plan_route_sharded_map(sched_env, monkeypatch):
+    from abpoa_tpu.parallel.scheduler import plan_route
+    monkeypatch.setenv("ABPOA_TPU_MESH", "4")
+    route = plan_route(_params("jax"), 32, workload="map")
+    assert route.kind == "sharded" and route.impl == "map"
+    assert route.workers == 4 and route.k_cap == 32
+    # no batched DP backend -> the mesh request cannot shard anything
+    route = plan_route(_params("numpy"), 32, workload="map")
+    assert route.kind == "serial"
+
+
+def test_sharded_k_cap_rides_its_own_noop(sched_env, monkeypatch):
+    """Sharded divergence feedback halves the PER-CHIP cap, scaled by the
+    mesh — and reads only the sharded route's own EWMA."""
+    from abpoa_tpu.parallel import scheduler
+    monkeypatch.setenv("ABPOA_TPU_MESH", "8")
+    scheduler.observe_lane_occupancy(0.4, route="sharded")
+    route = scheduler.plan_route(_params("jax"), 16)
+    assert route.kind == "sharded"
+    # noop ewma 0.6 -> 8 // 2 // 2 = 2 per chip, x 8 mesh
+    assert route.k_cap == 8 * 2
+
+
+def test_per_route_occupancy_isolation(sched_env):
+    """Small-fix regression (PR 19): the map stream's by-construction
+    ~1.0 occupancy must not feed the lockstep/sharded K-cap EWMAs, and a
+    divergent consensus drain must not starve the map cap."""
+    from abpoa_tpu.parallel import scheduler as s
+    for _ in range(6):
+        s.observe_lane_occupancy(1.0, route="map")
+    s.observe_lane_occupancy(0.25, route="lockstep")
+    assert s.occupancy_ewma("map") == pytest.approx(1.0)
+    assert s.occupancy_ewma("lockstep") == pytest.approx(0.25)
+    assert s.occupancy_ewma("sharded") == pytest.approx(1.0)  # untouched
+    # lockstep cap halves on ITS noop (0.75 -> three halvings of 8)
+    assert s.noop_k_cap(8, route="lockstep") == 1
+    # map cap stays wide open despite the lockstep drain
+    assert s.noop_k_cap(8, route="map") == 8
+    assert s.noop_k_cap(8, route="sharded") == 8
+    # the pooled mean still sees every observation (gate A/B estimator)
+    assert s.occupancy_mean() == pytest.approx((6 * 1.0 + 0.25) / 7)
+    assert s.occupancy_mean("lockstep") == pytest.approx(0.25)
+
+
+# --------------------------------------------------------------------- #
+# promoted dryrun phase 1: fused read sets shard_vmapped over the mesh  #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.slow
+def test_fused_sets_shard_vmap():
+    """__graft_entry__ dryrun phase 1, pytest-owned: every mesh slot runs
+    the single-dispatch fused progressive-POA loop on its own read set
+    inside one jitted shard_map step; all sets must consume every read
+    with zero error flags."""
+    import jax
+    import jax.numpy as jnp
+    from abpoa_tpu.align.fused_loop import init_fused_state, run_fused_chunk
+    from abpoa_tpu.align.oracle import dp_inf_min
+    from abpoa_tpu.parallel.shard import discover_mesh, shard_vmap
+
+    mesh = discover_mesh(2)
+    abpt = _params("jax")
+    S, R, L, Qp = 2, 4, 96, 128
+    N, E, A, W = 512, 8, 8, 128
+    rng = np.random.default_rng(0)
+    ref = rng.integers(0, 4, (S, L))
+    seqs = np.zeros((S, R, Qp), dtype=np.int32)
+    lens = np.zeros((S, R), dtype=np.int32)
+    for s in range(S):
+        for r in range(R):
+            read = []
+            for b in ref[s]:
+                x = rng.random()
+                if x < 0.03:
+                    read.append((int(b) + int(rng.integers(1, 4))) % 4)
+                elif x < 0.05:
+                    read.append(int(b))
+                    read.append(int(rng.integers(0, 4)))
+                elif x < 0.07:
+                    pass
+                else:
+                    read.append(int(b))
+            read = np.array(read[: Qp - 2], dtype=np.int32)
+            seqs[s, r, : len(read)] = read
+            lens[s, r] = len(read)
+    wgts = np.ones((S, R, Qp), dtype=np.int32)
+    mat = np.ascontiguousarray(abpt.mat.astype(np.int32))
+    qp = np.zeros((S, R, abpt.m, Qp), dtype=np.int32)
+    for s in range(S):
+        for r in range(R):
+            ln = int(lens[s, r])
+            qp[s, r, :, 1: ln + 1] = mat[:, seqs[s, r, :ln]]
+    inf_min = dp_inf_min(abpt)
+    mat_d = jnp.asarray(mat)
+
+    def one_set(seqs_pad, wgts_pad, lens_set, qp_set):
+        st = init_fused_state(N, E, A, n_reads=R, Pcap=Qp + 2)
+        st = run_fused_chunk(
+            st, seqs_pad, wgts_pad, lens_set, jnp.int32(R),
+            qp_set, mat_d, jnp.int32(abpt.wb), jnp.float32(abpt.wf),
+            jnp.int32(inf_min),
+            jnp.int32(abpt.gap_open1), jnp.int32(abpt.gap_ext1),
+            jnp.int32(abpt.gap_oe1), jnp.int32(abpt.gap_open2),
+            jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
+            gap_mode=abpt.gap_mode, W=W, max_ops=N + Qp + 8,
+            gap_on_right=bool(abpt.put_gap_on_right),
+            put_gap_at_end=bool(abpt.put_gap_at_end))
+        return jnp.stack([st.read_idx, st.err, st.g.node_n])
+
+    @jax.jit
+    def step(a, b, c, d):
+        return shard_vmap(one_set, mesh, 4)(a, b, c, d)
+
+    out = np.asarray(step(jnp.asarray(seqs), jnp.asarray(wgts),
+                          jnp.asarray(lens), jnp.asarray(qp)))
+    assert (out[:, 0] == R).all(), f"unconsumed reads: {out[:, 0]}"
+    assert (out[:, 1] == 0).all(), f"error flags: {out[:, 1]}"
+    assert (out[:, 2] > 2).all()
+
+
+# --------------------------------------------------------------------- #
+# promoted dryrun phase 4: map-batch sharding on one static graph       #
+# --------------------------------------------------------------------- #
+
+def _static_graph_and_reads(n_base=4, n_reads=4, L=96, seed=11):
+    from abpoa_tpu.align.dp_chunk import StaticGraphTables
+    from abpoa_tpu.pipeline import Abpoa, poa
+    rng = np.random.default_rng(seed)
+    ref = rng.integers(0, 4, L).astype(np.uint8)
+    abpt = _params("jax")
+    ab = Abpoa()
+    base_reads = []
+    for _ in range(n_base):
+        r = ref.copy()
+        muts = rng.integers(0, L, 3)
+        r[muts] = (r[muts] + 1) % 4
+        base_reads.append(r)
+    for q in base_reads:
+        ab.append_read(seq="x" * len(q))
+    poa(ab, abpt, base_reads,
+        [np.ones(len(q), dtype=np.int64) for q in base_reads], 0)
+    reads = []
+    for _ in range(n_reads):
+        r = ref.copy()
+        muts = rng.integers(0, L, 5)
+        r[muts] = (r[muts] + 1) % 4
+        reads.append(r)
+    return abpt, ab.graph, StaticGraphTables(ab.graph, abpt), reads
+
+
+@pytest.mark.slow
+def test_shard_dp_round_matches_unsharded_and_oracle():
+    """__graft_entry__ dryrun phase 4, pytest-owned: the graph tables
+    replicate into every shard while the read batch shards across the
+    mesh. The sharded round's packed rows must be byte-identical to the
+    unsharded dispatch, and every lane's GAF record must byte-match the
+    per-read host oracle."""
+    from abpoa_tpu.align.dp_chunk import (chunk_plane16, dispatch_dp_chunk,
+                                          result_from_chunk)
+    from abpoa_tpu.compile.ladder import plan_chunk_buckets, qp_rung
+    from abpoa_tpu.io.gaf import gaf_record
+    from abpoa_tpu.parallel.map_driver import map_read_host
+    from abpoa_tpu.parallel.shard import discover_mesh, shard_dp_round
+
+    mesh = discover_mesh(2)
+    abpt, g, static, reads = _static_graph_and_reads()
+    Qp = qp_rung(max(len(q) for q in reads))
+    _qp, W, _local = plan_chunk_buckets(abpt, Qp - 2)
+    R, P = static.R, static.P
+    plane16 = chunk_plane16(abpt, Qp - 2, static.n_rows)
+    stamped = [static.tables_for(q, Qp) for q in reads]
+    Kb = 4
+    sharded = shard_dp_round(abpt, stamped, Kb, R, P, Qp, W, plane16, mesh)
+    unsharded = dispatch_dp_chunk(abpt, stamped, Kb, R, P, Qp, W, plane16)
+    assert sharded.dtype == unsharded.dtype
+    assert np.array_equal(sharded, unsharded), \
+        "sharded round diverged from the unsharded dispatch"
+    for k, q in enumerate(reads):
+        res, flags = result_from_chunk(abpt, sharded[k], stamped[k],
+                                       static.idx2nid)
+        assert not flags["overflow"] and not flags["bt_err"], \
+            f"lane {k} flags {flags}"
+        want_r, want_s = map_read_host(g, abpt, q)
+        got = gaf_record(f"r{k}", q, res, static.base_by_nid, strand="+")
+        want = gaf_record(f"r{k}", q, want_r, static.base_by_nid,
+                          strand=want_s)
+        assert got == want, f"lane {k} GAF diverged"
+
+
+@pytest.mark.slow
+def test_shard_dp_round_partial_fill_padding():
+    """k_real < Kb: padding lanes are born finished and land in the
+    trailing shards; live rows still byte-match the unsharded dispatch."""
+    from abpoa_tpu.align.dp_chunk import chunk_plane16, dispatch_dp_chunk
+    from abpoa_tpu.compile.ladder import plan_chunk_buckets, qp_rung
+    from abpoa_tpu.parallel.shard import discover_mesh, shard_dp_round
+
+    mesh = discover_mesh(2)
+    abpt, _g, static, reads = _static_graph_and_reads(n_reads=3)
+    Qp = qp_rung(max(len(q) for q in reads))
+    _qp, W, _local = plan_chunk_buckets(abpt, Qp - 2)
+    plane16 = chunk_plane16(abpt, Qp - 2, static.n_rows)
+    stamped = [static.tables_for(q, Qp) for q in reads]
+    sharded = shard_dp_round(abpt, stamped, 4, static.R, static.P, Qp, W,
+                             plane16, mesh)
+    unsharded = dispatch_dp_chunk(abpt, stamped, 4, static.R, static.P,
+                                  Qp, W, plane16)
+    assert sharded.shape[0] == 3
+    assert np.array_equal(sharded, unsharded)

@@ -1,0 +1,399 @@
+#!/usr/bin/env python
+"""Sharded-route gate (CI: shard-smoke job, beside map_gate/churn_gate).
+
+PR 19's claim is a scaling claim with a zero-drift contract: spreading
+the split driver's one-dispatch-per-round lane batch over a device mesh
+(`parallel/shard.shard_dp_round`) must change NOTHING but the device
+axis. On the virtual 8-device CPU mesh (the only mesh every CI host can
+build) the gate pins:
+
+- gate 1 (byte identity): sharded consensus output == the unsharded
+  split driver == the numpy host oracle, across the linear/affine/convex
+  gap-mode grid x lane counts {4, 12} x a churn joiner boarding
+  mid-flight; sharded map GAF == unsharded == the per-read host oracle
+- gate 2 (dispatch accounting): EXACTLY one sharded dispatch per map
+  round (compile-log records vs the map.rounds counter), and every
+  dispatch's bucket names the per-shard batch: K == global_Kb / mesh,
+  mesh == the gate mesh
+- gate 3 (zero misses): no XLA compile inside either timed window —
+  `warm --ladder quick` (with ABPOA_TPU_MESH set) plus the untimed
+  pre-dispatch covers every rung the timed runs request
+- gate 4 (throughput floor): sharded wall >= 0.95x the unsharded wall on
+  this 1-core host. Each side runs at ITS route's cap — unsharded at the
+  per-chip K, sharded at mesh x per-chip (plan_route's grant), so both
+  amortize the same per-lane vmap width. A virtual CPU mesh adds
+  partition overhead without adding silicon, so parity-ish is the honest
+  bar; on real multi-chip meshes the same harness (--bench) records the
+  speedup instead
+
+Exits 0 on pass, 1 on a violation. --inject-slowdown F (test hook)
+divides the sharded reads/s by F to prove the gate flips. --bench writes
+BENCH_shard.json beside the repo's other BENCH_* records.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+MESH_N = 8
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ABPOA_TPU_SKIP_PROBE", "1")
+# the mesh opt-in must land BEFORE the first jax backend init so the
+# virtual-device pin can take; it also makes warm_ladder cover the
+# sharded rungs (the sharded anchor is a recorded skip without it)
+os.environ.setdefault("ABPOA_TPU_MESH", str(MESH_N))
+
+REF_LEN = 2000          # timed shape: the quick-tier anchor (qmax 2200)
+GRAPH_READS = 8
+K_CAP = 8               # per-chip lane cap (the unsharded driver's K)
+# the sharded route prices the whole mesh: global cap = mesh x per-chip
+# (scheduler.plan_route's grant, and map_reads_split's own default) —
+# running sharded at the PER-CHIP cap would slice each shard down to a
+# 1-lane vmap and measure de-batching, not sharding
+SHARD_K_CAP = MESH_N * K_CAP
+RATIO_FLOOR = 0.95      # sharded wall-clock floor vs unsharded (1-core)
+
+GAP_GRID = (
+    ("convex", {}),
+    ("affine", {"gap_open2": 0}),
+    ("linear", {"gap_open1": 0, "gap_open2": 0}),
+)
+
+
+def _params(device="jax", **kw):
+    from abpoa_tpu.params import Params
+    abpt = Params()
+    abpt.device = device
+    for k, v in kw.items():
+        setattr(abpt, k, v)
+    abpt.finalize()
+    return abpt
+
+
+def _random_sets(rng, sizes, qlen_lo=200, qlen_hi=300, err=0.1):
+    import numpy as np
+    sets, wsets = [], []
+    for n in sizes:
+        L = int(rng.integers(qlen_lo, qlen_hi))
+        ref = rng.integers(0, 4, L).astype(np.uint8)
+        reads = []
+        for _ in range(n):
+            r = ref.copy()
+            posn = rng.integers(0, L, max(1, int(err * L)))
+            r[posn] = rng.integers(0, 4, len(posn))
+            reads.append(r)
+        sets.append(reads)
+        wsets.append([np.ones(len(r), dtype=np.int64) for r in reads])
+    return sets, wsets
+
+
+def _consensus_text(abpt, pg, n_reads) -> str:
+    import io
+    from abpoa_tpu.cons.consensus import generate_consensus
+    from abpoa_tpu.io.output import output_fx_consensus
+    buf = io.StringIO()
+    output_fx_consensus(generate_consensus(pg, abpt, n_reads), abpt, buf)
+    return buf.getvalue()
+
+
+def _host_consensus(gap_kw, seqs, weights) -> str:
+    from abpoa_tpu.pipeline import Abpoa, poa
+    abpt = _params("numpy", **gap_kw)
+    ab = Abpoa()
+    for r in seqs:
+        ab.append_read(seq="x" * len(r))
+    poa(ab, abpt, seqs, weights, 0)
+    return _consensus_text(abpt, ab.graph, len(seqs))
+
+
+class _JoinHook:
+    """Boards one scripted joiner and records every retire delivery."""
+
+    def __init__(self, join_round, joiner):
+        self.join_round = join_round
+        self.joiner = joiner
+        self.retired = {}
+
+    def on_round(self, round_i, live_sids):
+        if round_i == self.join_round:
+            return set(), [self.joiner]
+        return set(), []
+
+    def on_retire(self, sid, result, round_i):
+        self.retired[sid] = (result, round_i)
+
+
+def _check_consensus_grid(mesh) -> int:
+    """Gate 1, consensus half: gap modes x lane counts x churn join."""
+    import numpy as np
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    rc = 0
+    rng = np.random.default_rng(1900)
+    for mode, gap_kw in GAP_GRID:
+        for n_lanes in (4, 12):
+            sizes = [int(rng.integers(3, 7)) for _ in range(n_lanes)]
+            sets, wsets = _random_sets(rng, sizes)
+            abpt = _params("jax", **gap_kw)
+            sharded = progressive_poa_split_batch(sets, wsets, abpt,
+                                                  mesh=mesh)
+            unsharded = progressive_poa_split_batch(sets, wsets, abpt)
+            for i in range(n_lanes):
+                if sharded[i] is None or unsharded[i] is None:
+                    print(f"[shard-gate] FAIL: {mode} K={n_lanes} set {i} "
+                          "fell back", file=sys.stderr)
+                    rc = 1
+                    continue
+                got = _consensus_text(abpt, sharded[i][0], sizes[i])
+                flat = _consensus_text(abpt, unsharded[i][0], sizes[i])
+                want = _host_consensus(gap_kw, sets[i], wsets[i])
+                if got != flat or got != want:
+                    print(f"[shard-gate] FAIL: {mode} K={n_lanes} set {i} "
+                          "diverged (sharded vs "
+                          f"{'unsharded' if got != flat else 'oracle'})",
+                          file=sys.stderr)
+                    rc = 1
+            print(f"[shard-gate] consensus {mode} K={n_lanes}: "
+                  f"byte-identical across sharded/unsharded/oracle",
+                  file=sys.stderr)
+        # churn: a joiner boards round 2 of a divergent sharded group
+        sets, wsets = _random_sets(rng, [3, 7])
+        j_sets, j_wsets = _random_sets(rng, [4], qlen_hi=260)
+        abpt = _params("jax", **gap_kw)
+        hook = _JoinHook(2, (100, j_sets[0], j_wsets[0]))
+        outs = progressive_poa_split_batch(sets, wsets, abpt, churn=hook,
+                                           mesh=mesh)
+        ok = all(o is not None for o in outs) and \
+            hook.retired.get(100, (None,))[0] is not None
+        if ok:
+            for i in range(2):
+                if _consensus_text(abpt, outs[i][0], len(sets[i])) != \
+                        _host_consensus(gap_kw, sets[i], wsets[i]):
+                    ok = False
+            jres = hook.retired[100][0]
+            if _consensus_text(abpt, jres[0], len(j_sets[0])) != \
+                    _host_consensus(gap_kw, j_sets[0], j_wsets[0]):
+                ok = False
+        if not ok:
+            print(f"[shard-gate] FAIL: {mode} churn join diverged under "
+                  "sharding", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"[shard-gate] consensus {mode} churn join @2: "
+                  "byte-identical to the host oracle", file=sys.stderr)
+    return rc
+
+
+def _gaf(names, queries, outcomes, base_by_nid) -> bytes:
+    from abpoa_tpu.io.gaf import gaf_record
+    lines = [gaf_record(n, q, out[0], base_by_nid, strand=out[1])
+             for n, q, out in zip(names, queries, outcomes)]
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _payload(n_map_reads: int):
+    """map_gate's split-payload idiom: graph reads and map reads from ONE
+    simulated reference."""
+    n_total = GRAPH_READS + n_map_reads
+    sim = os.path.join("/tmp", f"shard_gate_{n_total}x{REF_LEN}.fa")
+    if not os.path.isfile(sim):
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests", "make_sim.py"),
+             "--ref-len", str(REF_LEN), "--n-reads", str(n_total),
+             "--err", "0.1", "--seed", "1900", "--out", sim], check=True)
+    from abpoa_tpu.io.fastx import read_fastx
+    recs = read_fastx(sim)
+    graph_fa = os.path.join("/tmp", f"shard_gate_graph_{REF_LEN}.fa")
+    with open(graph_fa, "w") as fp:
+        for r in recs[:GRAPH_READS]:
+            fp.write(f">{r.name}\n{r.seq}\n")
+    gfa = os.path.join("/tmp", f"shard_gate_graph_{REF_LEN}.gfa")
+    if not os.path.isfile(gfa):
+        subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", graph_fa,
+             "-r", "4", "--device", "numpy", "-o", gfa],
+            cwd=REPO, check=True)
+    return gfa, recs[GRAPH_READS:]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    metavar="F", help="divide sharded reads/s by F (test "
+                    "hook proving the gate flips)")
+    ap.add_argument("--n-reads", type=int, default=32,
+                    help="timed map-stream read count [%(default)s]")
+    ap.add_argument("--bench", action="store_true",
+                    help="write BENCH_shard.json at the repo root")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from abpoa_tpu import obs
+    from abpoa_tpu.compile.warm import warm_ladder
+    from abpoa_tpu.parallel import scheduler
+    from abpoa_tpu.parallel.map_driver import (load_static_graph,
+                                               map_read_host,
+                                               map_reads_split)
+    from abpoa_tpu.parallel.shard import discover_mesh
+
+    # build the mesh FIRST: the virtual-device pin must precede backend
+    # init, and everything below dispatches against it
+    mesh = discover_mesh(MESH_N)
+    assert mesh is not None and int(mesh.devices.size) == MESH_N
+    print(f"[shard-gate] mesh: {MESH_N} x "
+          f"{mesh.devices.flat[0].platform} (axis 'set')", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    w = warm_ladder("quick")
+    sharded_warm = [r for r in w["records"]
+                    if r.get("fn") == "run_dp_chunk[sharded]"
+                    or r.get("entry") == "run_dp_chunk[sharded]"]
+    print(f"[shard-gate] quick-ladder warm: {w['compiled']} compiled, "
+          f"{w['persistent_cache_hits']} cache loads, "
+          f"{len(sharded_warm)} sharded rungs, "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    if not sharded_warm or any("skipped" in r for r in sharded_warm):
+        print("[shard-gate] FAIL: warm quick did not cover the sharded "
+              "anchor (is ABPOA_TPU_MESH set before backend init?)",
+              file=sys.stderr)
+        return 1
+
+    rc = _check_consensus_grid(mesh)
+
+    # ---- map half of gate 1 + gate 2 (dispatch accounting) ------------ #
+    gfa, map_recs = _payload(args.n_reads)
+    abpt = _params("jax")
+    ab, static = load_static_graph(gfa, abpt)
+    encode = abpt.char_to_code
+    queries = [encode[np.frombuffer(r.seq.encode(), dtype=np.uint8)]
+               .astype(np.uint8) for r in map_recs]
+    names = [r.name for r in map_recs]
+    cells = sum(static.n_rows * (2 * len(q) + 1) for q in queries)
+    oracle = _gaf(names, queries,
+                  [map_read_host(ab.graph, abpt, q) for q in queries],
+                  static.base_by_nid)
+
+    # untimed pre-dispatch of BOTH timed shapes (gate 3 holds only the
+    # timed windows to zero misses), measured for dispatch accounting
+    obs.start_run()
+    scheduler.reset()
+    sharded_out = map_reads_split(static, queries, abpt,
+                                  k_cap=SHARD_K_CAP, mesh=mesh)
+    rep = obs.finalize_report()
+    rounds = rep["counters"].get("map.rounds", 0)
+    recs = [r for r in (rep.get("compiles") or {}).get("records", [])
+            if r["fn"] == "run_dp_chunk[sharded]"]
+    if len(recs) != rounds or rounds == 0:
+        print(f"[shard-gate] FAIL: {len(recs)} sharded dispatches for "
+              f"{rounds} map rounds (want exactly one per round)",
+              file=sys.stderr)
+        rc = 1
+    from abpoa_tpu.compile.ladder import k_rung
+    global_k = k_rung(min(len(queries), SHARD_K_CAP), MESH_N)
+    bad = [r["bucket"] for r in recs
+           if r["bucket"]["mesh"] != MESH_N
+           or r["bucket"]["K"] * MESH_N != global_k]
+    if bad:
+        print(f"[shard-gate] FAIL: sharded bucket is not the per-shard "
+              f"K/mesh slice: {bad[:3]}", file=sys.stderr)
+        rc = 1
+    if rc == 0 or not bad:
+        print(f"[shard-gate] dispatch accounting: {rounds} rounds, "
+              f"{len(recs)} sharded dispatches, per-shard batch "
+              f"K={global_k // MESH_N} (= {global_k}/{MESH_N})",
+              file=sys.stderr)
+    if _gaf(names, queries, sharded_out, static.base_by_nid) != oracle:
+        print("[shard-gate] FAIL: sharded map GAF is NOT byte-identical "
+              "to the per-read host oracle", file=sys.stderr)
+        rc = 1
+    map_reads_split(static, queries, abpt, k_cap=K_CAP)  # unsharded warm
+
+    # ---- timed A/B + gates 3 and 4 ------------------------------------ #
+    obs.start_run()
+    scheduler.reset()
+    t0 = time.perf_counter()
+    flat_out = map_reads_split(static, queries, abpt, k_cap=K_CAP)
+    wall_flat = time.perf_counter() - t0
+    scheduler.reset()
+    t0 = time.perf_counter()
+    sharded_out = map_reads_split(static, queries, abpt,
+                                  k_cap=SHARD_K_CAP, mesh=mesh)
+    wall_shard = time.perf_counter() - t0
+    occ = scheduler.occupancy_mean("sharded")
+    rep = obs.finalize_report()
+    misses = (rep.get("compiles") or {}).get("misses", 0)
+
+    shard_rps = len(queries) / wall_shard
+    flat_rps = len(queries) / wall_flat
+    if args.inject_slowdown:
+        shard_rps /= args.inject_slowdown
+        wall_shard *= args.inject_slowdown
+        print(f"[shard-gate] injected {args.inject_slowdown}x sharded "
+              "slowdown (test hook)", file=sys.stderr)
+    ratio = shard_rps / flat_rps
+    print(f"[shard-gate] unsharded (K={K_CAP}):          {flat_rps:8.2f} "
+          f"reads/s  {cells / wall_flat / 1e6:8.1f}M CUPS "
+          f"({wall_flat:.2f}s)", file=sys.stderr)
+    print(f"[shard-gate] sharded   (K={global_k}, mesh={MESH_N}): "
+          f"{shard_rps:8.2f} reads/s  "
+          f"{cells / wall_shard / 1e6:8.1f}M CUPS ({wall_shard:.2f}s)  "
+          f"-> {ratio:.2f}x", file=sys.stderr)
+    print(f"[shard-gate] sharded-lane occupancy {occ:.3f} | compile "
+          f"misses in timed windows: {misses}", file=sys.stderr)
+
+    if (_gaf(names, queries, sharded_out, static.base_by_nid) != oracle
+            or _gaf(names, queries, flat_out,
+                    static.base_by_nid) != oracle):
+        print("[shard-gate] FAIL: a timed run's GAF drifted from the "
+              "host oracle", file=sys.stderr)
+        rc = 1
+    if misses:
+        print(f"[shard-gate] FAIL: {misses} compile misses inside the "
+              "timed windows — warm did not cover a sharded rung",
+              file=sys.stderr)
+        rc = 1
+    if ratio < RATIO_FLOOR:
+        print(f"[shard-gate] FAIL: sharded throughput {ratio:.2f}x the "
+              f"unsharded driver (floor {RATIO_FLOOR}x on the 1-core "
+              "virtual mesh)", file=sys.stderr)
+        rc = 1
+
+    if args.bench:
+        bench = {
+            "workload": f"map {args.n_reads} reads x {REF_LEN} bp vs one "
+                        f"static graph, per-chip cap {K_CAP} "
+                        f"(sharded global cap {SHARD_K_CAP})",
+            "mesh": MESH_N,
+            "platform": str(mesh.devices.flat[0].platform),
+            "sharded": {"wall_s": round(wall_shard, 3),
+                        "reads_per_s": round(shard_rps, 2),
+                        "cups": round(cells / wall_shard, 0)},
+            "unsharded": {"wall_s": round(wall_flat, 3),
+                          "reads_per_s": round(flat_rps, 2),
+                          "cups": round(cells / wall_flat, 0)},
+            "ratio": round(ratio, 3),
+            "sharded_lane_occupancy": round(occ, 3),
+            "compile_misses_timed": misses,
+        }
+        out = os.path.join(REPO, "BENCH_shard.json")
+        with open(out, "w") as fp:
+            json.dump(bench, fp, indent=2)
+            fp.write("\n")
+        print(f"[shard-gate] wrote {out}", file=sys.stderr)
+
+    print("[shard-gate] " + ("PASS" if rc == 0 else "FAIL"),
+          file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
