@@ -425,7 +425,10 @@ def dispatch_dp_chunk(abpt: Params, table_list: List[dict], Kb: int, R: int,
     statics = chunk_statics(abpt, W, max_ops, plane16)
     bucket = dict(R=R, P=P, Qp=Qp, W=W, K=Kb, plane16=plane16,
                   gap_mode=abpt.gap_mode, align_mode=abpt.align_mode)
-    from ..obs import trace
+    import time as _time
+
+    from ..obs import rounds, trace
+    t_dp = _time.perf_counter()
     with trace.span("dp_chunk", "dp", args=dict(bucket, sets=k_real)):
         with registry.watch("run_dp_chunk", bucket):
             packed = run_dp_chunk(
@@ -440,6 +443,9 @@ def dispatch_dp_chunk(abpt: Params, table_list: List[dict], Kb: int, R: int,
                 jnp.int32(abpt.gap_ext2), jnp.int32(abpt.gap_oe2),
                 jnp.int32(max(abpt.zdrop, 0)), **statics)
             out = np.asarray(packed)  # sync inside the compile bracket
+    # the rounds timeline's dispatch wall brackets the same region as the
+    # dp_chunk trace span, so the two reconcile by construction
+    rounds.note_dispatch(_time.perf_counter() - t_dp)
     return out[:k_real]
 
 

@@ -270,6 +270,16 @@ def warm_main(argv) -> int:
           f"{summary['persistent_cache_hits']} persistent-cache hits, "
           f"{summary['xla_compile_s']}s in XLA) in {summary['wall_s']}s; "
           f"cache: {summary['cache_dir']}", file=sys.stderr)
+    # warm is a perf-bearing run: cold-start readiness is a trajectory
+    # metric too (a compile-cache regression shows up here first)
+    obs.ledger.append_record(obs.ledger.make_record(
+        "warm", workload=f"ladder:{args.ladder}", device=args.device,
+        compile_misses=summary.get("compiled"),
+        extra={"signatures": summary.get("signatures"),
+               "persistent_cache_hits": summary.get(
+                   "persistent_cache_hits"),
+               "xla_compile_s": summary.get("xla_compile_s"),
+               "wall_s": summary.get("wall_s")}))
     if args.report:
         fp = sys.stdout if args.report == "-" else open(args.report, "w")
         try:
@@ -495,6 +505,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["top"]:
         from .obs.top import top_main
         return top_main(raw[1:])
+    if raw[:1] == ["perf"]:
+        from .obs.perf import perf_main
+        return perf_main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.input is None:
         build_parser().print_help(sys.stderr)

@@ -59,6 +59,11 @@ def warm_ladder(tier: str = "quick", abpt=None,
             records.append({"entry": anchor.entry, "skipped": "no warmer"})
             continue
         for rec in w(abpt, anchor):
+            if "fn" not in rec:
+                # a warmer may decline an anchor (e.g. the sharded rungs
+                # with no mesh requested) by yielding a skipped record
+                records.append(rec)
+                continue
             key = (rec["fn"], tuple(sorted(
                 (k, str(v)) for k, v in rec["bucket"].items())))
             if key in seen:

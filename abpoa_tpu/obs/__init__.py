@@ -15,7 +15,7 @@ assumptions, capture.py for the `--profile-dir` hooks; README
 "Run telemetry" / "Metrics & SLOs" / "Observability" and PERF.md
 document the consumer side (bench.py, perf_gate, chip_watcher, CI
 metrics-smoke / serve-smoke)."""
-from . import archive, flight, metrics, trace
+from . import archive, flight, ledger, metrics, rounds, trace
 from .capture import device_capture, profile_dir, set_profile_dir
 from .compile_log import compile_watch
 from .report import (SCHEMA, SCHEMA_KEYS, SCHEMA_VERSION, RunReport, count,
@@ -40,5 +40,5 @@ __all__ = [
     "span", "instant", "span_totals", "export_chrome_trace", "tracer",
     "new_request_id", "request_ctx", "sampled", "export_request_trace",
     "compile_watch",
-    "archive", "flight", "metrics",
+    "archive", "flight", "ledger", "metrics", "rounds",
 ]

@@ -410,9 +410,15 @@ _PREFIX_FAMILIES = {
                "Absorbed dispatch/input faults by kind", "kind"),
     "inject": ("abpoa_injected_faults_total",
                "Fault-injector firings by kind", "kind"),
-    "scheduler": ("abpoa_scheduler_routes_total",
-                  "Batch/serve dispatch route decisions by route", "route"),
 }
+
+# scheduler decisions carry TWO labels — the route kind plus the
+# categorical decision code (`scheduler.<kind>.<code>` report counters),
+# so crossover-serial is distinguishable from explicit/ineligible-serial
+# in the ledger's route mix (ISSUE 20 small fix)
+_SCHED_FAMILY = ("abpoa_scheduler_routes_total",
+                 "Batch/serve dispatch route decisions by route and "
+                 "decision reason")
 
 _EXACT_FAMILIES = {
     "compile.hits": ("abpoa_compile_hits_total",
@@ -523,6 +529,11 @@ def publish_counter(name: str, n: int) -> None:
         _REGISTRY.counter(*exact).inc(n)
         return
     head, _, rest = name.partition(".")
+    if head == "scheduler":
+        kind, _, code = rest.partition(".")
+        _REGISTRY.counter(*_SCHED_FAMILY).inc(
+            n, route=kind, reason=code or "unspecified")
+        return
     fam = _PREFIX_FAMILIES.get(head)
     if fam is not None:
         _REGISTRY.counter(fam[0], fam[1]).inc(n, **{fam[2]: rest})
@@ -662,6 +673,47 @@ def publish_shard_occupancy(shard_i: int, occ: float) -> None:
             "Lane occupancy per mesh shard in the last sharded round "
             "(live lanes over the per-shard slice)").set(
             occ, shard=str(shard_i))
+
+
+def publish_round(route: str, wall_s: float, lanes: int,
+                  k_cap: int) -> None:
+    """One driver round sealed (obs/rounds.py): the round-wall histogram
+    the TPU soak reads sustained round cadence from, plus last-round
+    lane gauges for `top`."""
+    if not _ENABLED:
+        return
+    _REGISTRY.histogram(
+        "abpoa_round_wall_seconds",
+        "Wall seconds per lockstep/sharded/map driver round (log-bucket "
+        "sketch)").observe(wall_s)
+    _REGISTRY.gauge(
+        "abpoa_round_lanes",
+        "Live lanes in the last driver round").set(lanes)
+
+
+def publish_shard_skew(ratio: float, straggler: int,
+                       walls: Dict[int, float]) -> None:
+    """Last sharded round's skew verdict (obs/rounds.py): max/min
+    live-shard ratio, the straggler shard id (the max-live shard whose
+    estimated wall IS the measured fused dispatch wall), and per-shard
+    wall estimates."""
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge(
+        "abpoa_shard_skew_ratio",
+        "Max/min live-lane ratio across mesh shards in the last sharded "
+        "round (1.0 = perfectly level)").set(round(ratio, 6))
+    _REGISTRY.gauge(
+        "abpoa_shard_straggler",
+        "Shard id that gated the last sharded round (max live "
+        "lanes)").set(straggler)
+    g = _REGISTRY.gauge(
+        "abpoa_shard_round_wall_seconds",
+        "Estimated per-shard wall of the last sharded round (dispatch "
+        "wall attributed by live lanes; the straggler's estimate is the "
+        "measured wall)")
+    for i, w in walls.items():
+        g.set(round(w, 9), shard=str(i))
 
 
 def publish_join_wait(wait_s: float) -> None:

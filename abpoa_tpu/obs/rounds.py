@@ -1,0 +1,258 @@
+"""Per-round timeline: the bounded ring behind the shard-skew row.
+
+The lockstep/map/sharded drivers all run the same shape — one fused DP
+dispatch per round over a lane table — and the aggregate counters
+(`lockstep.chunks`, occupancy EWMAs) already say *how many* rounds ran.
+What they cannot answer is the question the first on-chip soak will ask
+within minutes: "which mesh shard was the straggler in round 12, and
+how skewed was that round?" — the per-stage/per-shard attribution SeGraM
+reports (arXiv:2205.05883). This module records it: every round lands
+one bounded-ring sample carrying the round wall, the DISPATCH wall (the
+fused device bracket alone, measured around the same code the
+`dp_chunk` trace span brackets, so the round timeline reconciles with
+`span_totals("dp")` by construction), live-lane count, K cap, and —
+when the round ran sharded — the per-shard live-lane split.
+
+Per-shard *walls* are estimates, and say so: a sharded round is ONE
+fused `shard_map` dispatch, so the host can only time the straggler
+(the fused wall IS the max shard wall). Each shard's wall is attributed
+proportionally to its live lanes; the max-live shard is the straggler
+whose estimate equals the measured dispatch wall exactly. The skew
+ratio (max/min over live shards) is exact in *lanes* even though the
+walls are modeled.
+
+Surfaces: `/metrics` (`abpoa_round_wall_seconds` histogram +
+`abpoa_shard_skew_ratio` / `abpoa_shard_round_wall_seconds{shard=}` /
+`abpoa_shard_straggler` gauges), extra Chrome trace tracks (tid 900 =
+rounds, 910+i = shard estimates) when tracing is armed, the `top`
+shard-skew row, and the `why` "slowest shard" line (serve attaches
+`skew_summary()` to sharded request records).
+
+Overhead contract mirrors trace.py: one lock acquire and one tuple
+store per round (rounds are ~10-100 ms each, so the hook is noise);
+the ring is bounded (``ABPOA_TPU_ROUNDS_CAP``, default 4096) and
+overwrites oldest, reporting `dropped()` instead of growing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+DEFAULT_CAPACITY = 4096
+
+# reserved Chrome-trace track ids: the round timeline and per-shard
+# estimate tracks must not collide with live thread tids (dense from 1)
+# or foreign worker pids
+ROUNDS_TID = 900
+SHARD_TID_BASE = 910
+
+
+class RoundSample(NamedTuple):
+    route: str                  # "lockstep" | "sharded" | "map"
+    t_start: float              # perf_counter at round start
+    wall_s: float               # full round wall (host fusion included)
+    dp_wall_s: float            # fused dispatch bracket(s) only
+    lanes: int                  # live lanes this round
+    k_cap: int                  # group capacity (global lanes if sharded)
+    mesh: int                   # mesh size (1 = unsharded)
+    shard_live: Optional[Tuple[int, ...]]  # per-shard live lanes
+
+
+# per-thread accumulation between begin_round() and record_round(): the
+# dispatch sites (align/dp_chunk.py, parallel/shard.py) note their walls
+# here without knowing which driver's round they serve; thread-local
+# because serve runs concurrent lockstep groups on worker threads
+_TLS = threading.local()
+
+
+def rounds_enabled() -> bool:
+    """ABPOA_TPU_ROUNDS=0 disables round recording — the operator
+    kill-switch, and the paired-server overhead check's OFF side."""
+    return os.environ.get("ABPOA_TPU_ROUNDS", "1") not in ("0", "off")
+
+
+def _capacity() -> int:
+    try:
+        return max(16, int(os.environ.get("ABPOA_TPU_ROUNDS_CAP",
+                                          str(DEFAULT_CAPACITY))))
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+class RoundRing:
+    """Bounded ring of RoundSamples (trace.Tracer's ring discipline)."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self.capacity = capacity or _capacity()
+        self._lock = threading.Lock()
+        self._buf: List[RoundSample] = []
+        self._n = 0
+
+    def add(self, s: RoundSample) -> None:
+        with self._lock:
+            if self._n < self.capacity:
+                self._buf.append(s)
+            else:
+                self._buf[self._n % self.capacity] = s
+            self._n += 1
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    @property
+    def total(self) -> int:
+        return self._n
+
+    def samples(self) -> List[RoundSample]:
+        with self._lock:
+            if self._n <= self.capacity:
+                return list(self._buf)
+            k = self._n % self.capacity
+            return self._buf[k:] + self._buf[:k]
+
+
+_RING = RoundRing()
+
+
+def ring() -> RoundRing:
+    return _RING
+
+
+def reset(capacity: Optional[int] = None) -> None:
+    global _RING
+    _RING = RoundRing(capacity)
+    _TLS.dp_wall = 0.0
+    _TLS.shard_live = None
+
+
+def dropped() -> int:
+    return _RING.dropped
+
+
+# ------------------------------------------------------------- recording
+
+def begin_round() -> None:
+    """Zero this thread's dispatch accumulation — called by the drivers
+    where they stamp the round start, so a warmer's stray dispatch on
+    the same thread can never leak into the next round's dp wall."""
+    _TLS.dp_wall = 0.0
+    _TLS.shard_live = None
+
+
+def note_dispatch(wall_s: float,
+                  shard_live: Optional[Sequence[int]] = None) -> None:
+    """One fused dispatch bracket completed on this thread: accumulate
+    its wall (W-growth retries and amb-strand re-dispatches sum) and,
+    for sharded rounds, keep the per-shard live-lane split."""
+    _TLS.dp_wall = getattr(_TLS, "dp_wall", 0.0) + float(wall_s)
+    if shard_live is not None:
+        _TLS.shard_live = tuple(int(x) for x in shard_live)
+
+
+def record_round(route: str, lanes: int, k_cap: int, wall_s: float,
+                 mesh: int = 1) -> RoundSample:
+    """Seal one round into the ring and fan it out to /metrics and the
+    trace. Called by the drivers at the point they already compute the
+    round's amortized share, so the hook adds no new clock reads to the
+    round loop beyond the dispatch bracket."""
+    dp_wall = getattr(_TLS, "dp_wall", 0.0)
+    shard_live = getattr(_TLS, "shard_live", None)
+    begin_round()
+    if not rounds_enabled():
+        return RoundSample(route=route, t_start=0.0, wall_s=float(wall_s),
+                           dp_wall_s=dp_wall, lanes=int(lanes),
+                           k_cap=int(k_cap), mesh=int(mesh),
+                           shard_live=shard_live)
+    s = RoundSample(route=route, t_start=time.perf_counter() - wall_s,
+                    wall_s=float(wall_s), dp_wall_s=dp_wall,
+                    lanes=int(lanes), k_cap=int(k_cap), mesh=int(mesh),
+                    shard_live=shard_live)
+    _RING.add(s)
+    from . import metrics, trace
+    metrics.publish_round(route, s.wall_s, s.lanes, s.k_cap)
+    if s.shard_live and s.mesh > 1:
+        walls = shard_wall_estimates(s)
+        ratio, straggler = skew_of(s)
+        metrics.publish_shard_skew(ratio, straggler, walls)
+    if trace.enabled():
+        _trace_round(s)
+    return s
+
+
+def shard_wall_estimates(s: RoundSample) -> Dict[int, float]:
+    """Per-shard wall estimates for one sharded round: the dispatch wall
+    attributed proportionally to live lanes (the fused dispatch is the
+    max-live shard's wall; emptier shards idle behind it)."""
+    live = s.shard_live or ()
+    peak = max(live) if live else 0
+    if peak <= 0:
+        return {i: 0.0 for i in range(len(live))}
+    return {i: s.dp_wall_s * n / peak for i, n in enumerate(live)}
+
+
+def skew_of(s: RoundSample) -> Tuple[float, int]:
+    """(skew ratio, straggler shard id) of one sharded round: max/min
+    live lanes over shards that had any (empty shards are excluded — a
+    drained trailing shard would make every ratio infinite); straggler =
+    the max-live shard, whose estimated wall is the measured one."""
+    live = s.shard_live or ()
+    if not live:
+        return 1.0, 0
+    peak = max(live)
+    straggler = live.index(peak)
+    floor = min((n for n in live if n > 0), default=peak)
+    return (peak / floor if floor else 1.0), straggler
+
+
+def _trace_round(s: RoundSample) -> None:
+    from . import trace
+    t = trace.tracer()
+    args = {"route": s.route, "lanes": s.lanes, "k_cap": s.k_cap,
+            "dp_wall_ms": round(s.dp_wall_s * 1e3, 3)}
+    if s.mesh > 1:
+        args["mesh"] = s.mesh
+    t.add_foreign("X", f"round[{s.route}]", "round", s.t_start, s.wall_s,
+                  ROUNDS_TID, args, None)
+    if s.shard_live and s.mesh > 1:
+        for i, w in shard_wall_estimates(s).items():
+            t.add_foreign("X", f"shard{i}", "round", s.t_start, w,
+                          SHARD_TID_BASE + i,
+                          {"live": s.shard_live[i], "est": True}, None)
+
+
+# --------------------------------------------------------------- reading
+
+def snapshot(n: int = 0) -> List[dict]:
+    """Newest `n` round samples (0 = all retained), oldest-first, as
+    plain dicts — the `why`/test-facing view."""
+    out = [s._asdict() for s in _RING.samples()]
+    return out[-n:] if n else out
+
+
+def dp_wall_total(route: Optional[str] = None) -> float:
+    """Sum of recorded dispatch walls — the reconcile test pins this
+    within 5% of `trace.span_totals("dp")`'s `dp_chunk` sum."""
+    return sum(s.dp_wall_s for s in _RING.samples()
+               if route is None or s.route == route)
+
+
+def skew_summary() -> Optional[dict]:
+    """The newest sharded round's skew verdict, None when no sharded
+    round ran: what serve attaches to a sharded request's archive record
+    and `why` renders as the "slowest shard" line."""
+    for s in reversed(_RING.samples()):
+        if s.mesh > 1 and s.shard_live:
+            ratio, straggler = skew_of(s)
+            walls = shard_wall_estimates(s)
+            return {
+                "slowest_shard": straggler,
+                "shard_skew": round(ratio, 3),
+                "round_wall_ms": round(s.wall_s * 1e3, 3),
+                "shard_wall_ms": {str(i): round(w * 1e3, 3)
+                                  for i, w in walls.items()},
+                "shard_live": list(s.shard_live),
+            }
+    return None

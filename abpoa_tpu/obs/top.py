@@ -112,7 +112,14 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
     # the selected route, lockstep K cap, route-decision counts, and the
     # measured divergence EWMA the K-cap heuristic feeds on
     route_hot = _labeled(samples, "abpoa_scheduler_route", "route")
-    routes = _labeled(samples, "abpoa_scheduler_routes_total", "route")
+    # the route counter carries a `reason` label too (crossover vs
+    # ineligible vs eligible...), so per-route display sums over reasons
+    routes: Dict[str, float] = {}
+    for (name, labels), v in samples.items():
+        if name == "abpoa_scheduler_routes_total":
+            r = dict(labels).get("route")
+            if r is not None:
+                routes[r] = routes.get(r, 0.0) + v
     if route_hot or routes:
         cur = next((k for k, v in route_hot.items() if v >= 1), "?")
         k_cap = M.sample_value(samples, "abpoa_scheduler_k_cap")
@@ -141,6 +148,26 @@ def render_frame(samples, types, path: str, age_s: float) -> str:
                     f"{s}:{v:.2f}" for s, v in sorted(
                         shard_occ.items(), key=lambda kv: int(kv[0])))
             lines.append(f"         mesh {mesh_n:.0f}x{plat}{occ_s}")
+            # shard-skew row (obs/rounds.py): max/min estimated shard
+            # wall of the last sharded round + the straggler shard that
+            # gated it — the round-12-straggler question, live
+            skew = M.sample_value(samples, "abpoa_shard_skew_ratio")
+            shard_walls = _labeled(samples,
+                                   "abpoa_shard_round_wall_seconds",
+                                   "shard")
+            if skew is not None and shard_walls:
+                straggler = M.sample_value(samples,
+                                           "abpoa_shard_straggler")
+                walls = sorted(shard_walls.items(),
+                               key=lambda kv: kv[1])
+                lo_s, lo_w = walls[0]
+                hi_s, hi_w = walls[-1]
+                lines.append(
+                    f"         skew {skew:.2f}x  round wall "
+                    f"max {1e3 * hi_w:.2f} ms (shard {hi_s}) / "
+                    f"min {1e3 * lo_w:.2f} ms (shard {lo_s})  "
+                    f"straggler shard "
+                    f"{straggler if straggler is None else int(straggler)}")
         chunks = _total(samples, "abpoa_lockstep_chunks_total")
         drains = _total(samples, "abpoa_lockstep_drain_chunks_total")
         if chunks:
@@ -272,28 +299,52 @@ def _read_frame(path: str) -> Tuple[str, float]:
     return text, age
 
 
+def _fetch_frame(url: str, timeout: float = 5.0) -> Tuple[str, float]:
+    """Scrape a live /metrics endpoint (a serve replica's HTTP exporter,
+    or the fleet router's merged exposition) — the no-filesystem-access
+    path a fleet operator watches a remote router through. A fetched
+    frame is by definition fresh (age 0)."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace"), 0.0
+
+
 def top_main(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="abpoa-tpu top",
         description="live terminal dashboard over the --metrics exporter "
-                    "file of a concurrent run")
+                    "file of a concurrent run, or a live /metrics "
+                    "endpoint (--url)")
     ap.add_argument("file", nargs="?", default=M.default_textfile_path(),
                     help="exporter textfile to watch "
                          "[%(default)s]")
+    ap.add_argument("--url", default=None, metavar="URL",
+                    help="scrape a live endpoint instead of the textfile "
+                         "(e.g. http://host:port/metrics — a serve "
+                         "replica or the fleet router's merged "
+                         "exposition)")
     ap.add_argument("-n", "--interval", type=float, default=1.0,
                     help="refresh interval seconds [%(default)s]")
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (no screen clearing)")
     args = ap.parse_args(argv)
+    src = args.url or args.file
     while True:
         try:
-            text, age = _read_frame(args.file)
+            if args.url:
+                text, age = _fetch_frame(args.url)
+            else:
+                text, age = _read_frame(args.file)
             samples, types = M.parse_exposition(text)
-            frame = render_frame(samples, types, args.file, age)
-        except OSError:
-            frame = (f"abpoa-tpu top — waiting for {args.file}\n"
-                     "(start a run with `--metrics "
-                     f"{args.file}` to feed it)\n")
+            frame = render_frame(samples, types, src, age)
+        except OSError as e:
+            if args.url:
+                frame = (f"abpoa-tpu top — waiting for {args.url}\n"
+                         f"({e})\n")
+            else:
+                frame = (f"abpoa-tpu top — waiting for {args.file}\n"
+                         "(start a run with `--metrics "
+                         f"{args.file}` to feed it)\n")
         except ValueError as e:
             frame = f"abpoa-tpu top — unparseable exposition: {e}\n"
         if args.once:

@@ -276,6 +276,16 @@ def render_why(record: Optional[dict], trace_doc: Optional[dict],
         lines.append(f"route: {record.get('route') or 'sharded'} "
                      f"K={record.get('k_cap') or '?'} "
                      f"over mesh={record['mesh']}")
+        # shard-skew attribution (obs/rounds.py via serve account()):
+        # which shard gated the request's last sharded round, and by how
+        # much the mesh was out of level
+        if record.get("slowest_shard") is not None:
+            wall = record.get("round_wall_ms")
+            lines.append(
+                f"slowest shard: {record['slowest_shard']} "
+                f"(skew {record.get('shard_skew', 1.0):.2f}x"
+                + (f", round wall {wall:.2f} ms" if wall else "")
+                + ")")
     lines.append("")
     lines.append("verdict: " + verdict(record, trace_doc, dump))
 

@@ -191,6 +191,7 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
         if not lanes:
             break
         t_round = time.perf_counter()
+        obs.rounds.begin_round()
         round_i += 1
         obs.count("lockstep.chunks")
         # measured lane occupancy: live lanes over the group's high-water
@@ -218,7 +219,8 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
             else:
                 dp_lanes.append(lane)
         if not dp_lanes:
-            _record_round(abpt, done_this_round, t_round)
+            _record_round(abpt, done_this_round, t_round, route=occ_route,
+                          lanes=len(active), k_cap=capacity, mesh=S)
             continue
 
         with obs.phase("align"):
@@ -328,20 +330,26 @@ def progressive_poa_split_batch(seq_sets: List[List[np.ndarray]],
                     # finish: result to its future, slot freed for joiners
                     retire(lane, (lane.graph, lane.is_rc), round_i)
 
-        _record_round(abpt, done_this_round, t_round)
+        _record_round(abpt, done_this_round, t_round, route=occ_route,
+                      lanes=len(active), k_cap=capacity, mesh=S)
 
     return [final.get(sid) for sid in initial_sids]
 
 
 def _record_round(abpt: Params, done: List[Tuple[int, int]],
-                  t_round: float) -> None:
+                  t_round: float, route: str = "lockstep", lanes: int = 0,
+                  k_cap: int = 1, mesh: int = 1) -> None:
     """Amortized per-read latency records (the lockstep contract: a share
-    of the round wall per advanced read, flagged amortized)."""
+    of the round wall per advanced read, flagged amortized), plus the
+    round's sample into the obs/rounds.py timeline ring (round wall,
+    dispatch wall, live lanes, per-shard split)."""
+    from .. import obs
+    wall = time.perf_counter() - t_round
+    obs.rounds.record_round(route, lanes, k_cap, wall, mesh=mesh)
     if not done:
         return
-    from .. import obs
     from ..pipeline import _band_cols
-    share = (time.perf_counter() - t_round) / len(done)
+    share = wall / len(done)
     for _k, qlen in done:
         obs.record_read(share, qlen, _band_cols(abpt, qlen),
                         abpt.device, amortized=True)

@@ -197,6 +197,7 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
             break
         round_i += 1
         t_round = time.perf_counter()
+        obs.rounds.begin_round()
         obs.count("map.rounds")
         occ = len(lanes) / k_cap
         scheduler.observe_lane_occupancy(occ, route=occ_route)
@@ -275,7 +276,9 @@ def map_reads_split(static, queries: Sequence[np.ndarray], abpt: Params,
             retire(rid, (res, strand, fallback), round_i)
             n_done += 1
         obs.count("map.reads", n_done)
-        share = (time.perf_counter() - t_round) / max(n_done, 1)
+        wall = time.perf_counter() - t_round
+        obs.rounds.record_round(occ_route, len(lanes), k_cap, wall, mesh=S)
+        share = wall / max(n_done, 1)
         for _rid, q in lanes:
             obs.record_read(share, len(q), _band_cols(abpt, len(q)),
                             abpt.device, amortized=True,

@@ -52,6 +52,16 @@ class Route(NamedTuple):
                     # mesh x per-chip cap)
     workers: int    # worker processes (pool/hybrid); mesh size (sharded)
     reason: str
+    code: str = "unspecified"
+                    # categorical decision code behind `reason`'s free
+                    # text — the `reason` label on
+                    # abpoa_scheduler_routes_total, so the perf ledger's
+                    # route mix can tell crossover-serial ("crossover")
+                    # from ineligible/explicit-serial ("ineligible"),
+                    # multicore pool ("multicore"), an eligible lockstep/
+                    # map grant ("eligible"), a mesh upgrade ("mesh"),
+                    # hybrid group workers ("workers"), or an empty batch
+                    # ("empty")
 
 
 # measured-feedback state, PER ROUTE: the idle-lane (noop) EWMA that caps
@@ -226,7 +236,7 @@ def plan_route(abpt, n_sets: int, serve: bool = False,
         route = _plan(abpt, n_sets, serve, _lockstep_ok,
                       lockstep_group_size, qlen, mesh_n)
     from ..obs import count, metrics, trace
-    count(f"scheduler.{route.kind}")
+    count(f"scheduler.{route.kind}.{route.code}")
     metrics.publish_route(route)
     # route decisions land on the trace timeline too: a request whose
     # group ran serial-fallback (or K-capped) can show why in its tree
@@ -241,44 +251,47 @@ def _plan_map(abpt, n_reads, lockstep_group_size, mesh_n: int = 0) -> Route:
     crossover — a short read costs one round like a long one. A >= 2
     mesh request shards the SAME rounds (kind "sharded", impl "map")."""
     if n_reads <= 0:
-        return Route("serial", "", 1, 1, "empty read stream")
+        return Route("serial", "", 1, 1, "empty read stream", "empty")
     if abpt.device not in ("jax", "tpu", "pallas"):
         return Route("serial", "", 1, 1,
-                     f"device {abpt.device!r} has no batched DP chunk")
+                     f"device {abpt.device!r} has no batched DP chunk",
+                     "ineligible")
     base_k = lockstep_group_size()
     if mesh_n >= 2:
         per_chip = noop_k_cap(base_k, route="sharded")
         return Route("sharded", "map", mesh_n * per_chip, mesh_n,
                      f"sharded map K={mesh_n * per_chip} over mesh={mesh_n}"
-                     f" ({mesh_n} x per-chip k_cap {per_chip})")
+                     f" ({mesh_n} x per-chip k_cap {per_chip})", "mesh")
     k_cap = noop_k_cap(base_k, route="map")
     reason = f"map split k_cap={k_cap}"
     if k_cap != base_k:
         reason += (f" (noop ewma {_NOOP['map']['ewma']:.2f} "
                    f"capped {base_k})")
-    return Route("map", "split", k_cap, 1, reason)
+    return Route("map", "split", k_cap, 1, reason, "eligible")
 
 
 def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
           qlen=None, mesh_n: int = 0) -> Route:
     if n_sets <= 0:
-        return Route("serial", "", 1, 1, "empty batch")
+        return Route("serial", "", 1, 1, "empty batch", "empty")
     min_q = lockstep_min_qlen()
     below_crossover = qlen is not None and qlen < min_q
     if not _lockstep_ok(abpt) or below_crossover:
+        code = "crossover" if below_crossover else "ineligible"
         why = (f"qlen {qlen} < serial-wins crossover {min_q}"
                if below_crossover else "lockstep ineligible")
         if serve:
-            return Route("serial", "", 1, 1, why)
+            return Route("serial", "", 1, 1, why, code)
         from .pool import resolve_workers
         w = resolve_workers(abpt, n_sets)
         if w > 1 and n_sets > 1:
             return Route("pool", "", 1, w,
                          f"{w} workers over {n_sets} sets (CPU multicore)"
-                         + (f"; {why}" if below_crossover else ""))
+                         + (f"; {why}" if below_crossover else ""),
+                         "multicore")
         return Route("serial", "", 1, 1,
                      why if below_crossover
-                     else "single set/core, or lockstep ineligible")
+                     else "single set/core, or lockstep ineligible", code)
     impl = lockstep_impl(abpt)
     base_k = lockstep_group_size()
     if mesh_n >= 2 and impl == "split":
@@ -289,7 +302,7 @@ def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
         per_chip = noop_k_cap(base_k, route="sharded")
         return Route("sharded", "split", mesh_n * per_chip, mesh_n,
                      f"sharded K={mesh_n * per_chip} over mesh={mesh_n} "
-                     f"({mesh_n} x per-chip k_cap {per_chip})")
+                     f"({mesh_n} x per-chip k_cap {per_chip})", "mesh")
     k_cap = noop_k_cap(base_k)
     reason = f"impl={impl} k_cap={k_cap}"
     if k_cap != base_k:
@@ -300,5 +313,6 @@ def _plan(abpt, n_sets, serve, _lockstep_ok, lockstep_group_size,
         if w > 1 and n_sets > k_cap:
             groups = -(-n_sets // k_cap)
             return Route("hybrid", impl, k_cap, min(w, groups),
-                         reason + f" x {min(w, groups)} group workers")
-    return Route("lockstep", impl, k_cap, 1, reason)
+                         reason + f" x {min(w, groups)} group workers",
+                         "workers")
+    return Route("lockstep", impl, k_cap, 1, reason, "eligible")

@@ -244,14 +244,24 @@ def shard_dp_round(abpt: Params, table_list: List[dict], Kb: int, R: int,
     bucket = dict(R=R, P=P, Qp=Qp, W=W, K=k_per, mesh=S, plane16=plane16,
                   gap_mode=abpt.gap_mode, align_mode=abpt.align_mode)
     metrics.publish_mesh(S, mesh.devices.flat[0].platform)
+    shard_live = []
     for i in range(S):
         live = min(max(k_real - i * k_per, 0), k_per)
+        shard_live.append(live)
         metrics.publish_shard_occupancy(i, live / k_per)
+    import time as _time
+
+    from ..obs import rounds
+    t_dp = _time.perf_counter()
     with trace.span("dp_chunk", "dp", args=dict(bucket, sets=k_real)):
         with registry.watch("run_dp_chunk[sharded]", bucket):
             packed = _sharded_jit()(*lane_args, *shared, mesh=mesh,
                                     **statics)
             out = np.asarray(packed)  # sync inside the compile bracket
+    # per-shard live split + dispatch wall feed the obs/rounds.py ring:
+    # the fused shard_map bracket is the straggler's wall, the live split
+    # is what skew/straggler attribution derives from
+    rounds.note_dispatch(_time.perf_counter() - t_dp, shard_live=shard_live)
     return out.reshape((Kb,) + out.shape[2:])[:k_real]
 
 

@@ -667,6 +667,14 @@ class AlignServer:
             rec["k_cap"] = self._sharded_k_cap(
                 lockstep_group_size(),
                 "map" if job.kind == "map" else "lockstep")
+            # shard-skew attribution (obs/rounds.py): the newest sharded
+            # round's straggler + skew land on the record so `why` can
+            # name the slowest shard without access to this process's ring
+            skew = obs.rounds.skew_summary()
+            if skew:
+                rec["slowest_shard"] = skew["slowest_shard"]
+                rec["shard_skew"] = skew["shard_skew"]
+                rec["round_wall_ms"] = skew["round_wall_ms"]
         rec["request_id"] = job.rid or None
         if job.dumps:
             rec["dump_file"] = job.dumps[-1]

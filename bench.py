@@ -358,6 +358,26 @@ def main():
         },
     }))
 
+    # one trajectory record per bench run (obs/ledger.py): the committed
+    # BENCH_* files are point-in-time; the ledger is the series the drift
+    # gate (`abpoa-tpu perf --gate`) medians over
+    try:
+        from abpoa_tpu.obs import ledger
+        rep10k = phases.get("sim10k_500") or {}
+        ledger.append_record(ledger.make_record(
+            "bench", workload="sim10k_500", device=dev10k,
+            reads_per_sec=rps10k,
+            cell_updates_per_sec=rep10k.get("cell_updates_per_sec"),
+            mfu=rep10k.get("mfu"),
+            read_wall_ms=rep10k.get("read_wall_ms"),
+            verdict=None,
+            extra={"vs_baseline": round(rps10k / base10k, 4)
+                   if base10k else None,
+                   "sim2k_reads_per_sec": round(rps2k, 3),
+                   "sim2k_device": dev2k}))
+    except Exception as e:  # the ledger must never fail the bench
+        print(f"[bench] ledger append failed: {e}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,316 @@
+"""Performance-trajectory ledger + round/shard timeline tests (round 20).
+
+- schema golden: `ledger.make_record` pins schema v1's exact key set and
+  lints clean; `lint_record` catches shape drift
+- append/rotation: O_APPEND JSONL round-trips, `append_unique` is
+  idempotent, rotation keeps exactly ONE prior generation and
+  `read_window` spans the boundary
+- drift gate: pure `drift_check` verdicts (regression flagged, short
+  history vacuous), and the `abpoa-tpu perf --gate` subprocess flips
+  rc 0 -> 1 under --inject-slowdown (the self-test contract every gate
+  carries)
+- backfill: tools/ledger_backfill.py imports >= 15 records from the
+  repo's BENCH_*/MULTICHIP_*/baseline files and re-runs as a no-op
+- round ring: bounded overwrite with a dropped() count, per-shard wall
+  estimates/skew/straggler math, skew_summary for `why`
+- reconcile: a real lockstep run's round-timeline dp walls sum to within
+  5% of the `dp` trace-span totals (they bracket the same region by
+  construction)
+- `top`: the shard-skew row renders from published skew gauges
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def ledger_dir(tmp_path, monkeypatch):
+    d = tmp_path / "ledger"
+    monkeypatch.setenv("ABPOA_TPU_LEDGER_DIR", str(d))
+    monkeypatch.delenv("ABPOA_TPU_LEDGER", raising=False)
+    monkeypatch.delenv("ABPOA_TPU_LEDGER_MAX_MB", raising=False)
+    return d
+
+
+# ------------------------------------------------------------- schema
+
+
+def test_schema_golden_record(ledger_dir):
+    """Schema v1's key set is pinned: adding/renaming a field must be a
+    conscious schema_version bump, not drift."""
+    from abpoa_tpu.obs import ledger
+    rec = ledger.make_record(
+        "perf_gate", workload="sim2k", device="native", route="serial",
+        rung={"K": 4}, reads_per_sec=359.7, cell_updates_per_sec=9.8e7,
+        mfu=0.12, occupancy=0.9,
+        read_wall_ms={"p50": 2.5, "p95": 5.5, "p99": 5.5},
+        compile_misses=0, verdict="pass")
+    assert set(rec) == set(ledger.REQUIRED_KEYS)
+    assert rec["schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    assert rec["host"]["cpus"] >= 1
+    assert rec["rung"] == {"K": 4}
+    assert len(rec["key"]) == 16
+    assert ledger.lint_record(rec) == []
+    # extra is the only optional key, carried verbatim
+    rec2 = ledger.make_record("bench", extra={"vs_baseline": 3.0})
+    assert set(rec2) == set(ledger.REQUIRED_KEYS) | {"extra"}
+    assert ledger.lint_record(rec2) == []
+
+
+def test_lint_record_catches_drift():
+    from abpoa_tpu.obs import ledger
+    rec = ledger.make_record("bench", workload="sim2k")
+    assert ledger.lint_record(rec) == []
+    bad = dict(rec, schema_version=99, rung="K=4", reads_per_sec="fast")
+    bad.pop("verdict")
+    problems = "\n".join(ledger.lint_record(bad))
+    assert "schema_version" in problems
+    assert "rung is not a dict" in problems
+    assert "reads_per_sec is not numeric" in problems
+    assert "missing key 'verdict'" in problems
+
+
+# --------------------------------------------------- append + rotation
+
+
+def test_append_roundtrip_and_unique(ledger_dir):
+    from abpoa_tpu.obs import ledger
+    rec = ledger.make_record("bench", workload="sim2k", reads_per_sec=10.0)
+    path = ledger.append_record(rec)
+    assert path == str(ledger_dir / "PERF_LEDGER.jsonl")
+    assert ledger.append_unique(rec) is None          # same key: skipped
+    win = ledger.read_window(0)
+    assert len(win) == 1 and win[0]["key"] == rec["key"]
+    # append_and_verify (the smokes' self-check) is clean on a good record
+    rec2 = ledger.make_record("serve_smoke", workload="soak", verdict="pass")
+    assert ledger.append_and_verify(rec2) == []
+    # and silent when the ledger is operator-disabled
+    os.environ["ABPOA_TPU_LEDGER"] = "0"
+    try:
+        assert ledger.append_and_verify(rec2) == []
+        assert ledger.append_record(rec2) is None
+    finally:
+        del os.environ["ABPOA_TPU_LEDGER"]
+
+
+def test_rotation_keeps_one_generation(ledger_dir, monkeypatch):
+    """Past the size cap the live file rotates to `.1`; a second rotation
+    REPLACES `.1` (one prior generation, never `.2`), and read_window
+    spans the boundary."""
+    monkeypatch.setenv("ABPOA_TPU_LEDGER_MAX_MB", "0.002")   # 2 kB cap
+    from abpoa_tpu.obs import ledger
+    for i in range(40):  # ~500 B/record -> several rotations
+        ledger.append_record(ledger.make_record(
+            "bench", workload="sim2k", reads_per_sec=float(i),
+            key=f"rot{i:02d}"))
+    path = ledger.ledger_path()
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")
+    win = ledger.read_window(0)
+    keys = [r["key"] for r in win]
+    assert keys == sorted(keys)                  # oldest-first, in order
+    assert keys[-1] == "rot39"                   # newest survived
+    assert len(win) < 40                         # old generations dropped
+    # the window spans the rotation boundary: some records live in .1
+    with open(path) as fp:
+        live = fp.read().count("\n")
+    assert len(win) > live
+
+
+# ----------------------------------------------------------- drift gate
+
+
+def _mk(ledger, rps, i):
+    return ledger.make_record("g", workload="w", reads_per_sec=rps,
+                              key=f"d{i:02d}")
+
+
+def test_drift_check_flags_regression(ledger_dir):
+    from abpoa_tpu.obs import ledger
+    window = [_mk(ledger, 100.0, i) for i in range(5)]
+    window.append(_mk(ledger, 50.0, 9))         # 0.5x median: below 0.6
+    verdicts = ledger.drift_check(window, metrics=("reads_per_sec",))
+    assert [v["ok"] for v in verdicts] == [False]
+    assert verdicts[0]["median"] == 100.0
+    # same history, healthy current: passes
+    ok = ledger.drift_check(window[:-1] + [_mk(ledger, 95.0, 9)],
+                            metrics=("reads_per_sec",))
+    assert ok[0]["ok"]
+    # short history is vacuous
+    short = ledger.drift_check(window[:3], metrics=("reads_per_sec",))
+    assert short[0]["ok"] and short[0]["note"] == "history<min"
+
+
+def test_perf_gate_subprocess_flip(ledger_dir):
+    """The CI contract, end to end: `abpoa-tpu perf --gate` exits 0 on a
+    healthy trajectory and 1 under --inject-slowdown; an empty ledger is
+    rc 1 (the gate must not vacuously pass with no history)."""
+    from abpoa_tpu.obs import ledger
+    env = dict(os.environ, ABPOA_TPU_LEDGER_DIR=str(ledger_dir),
+               JAX_PLATFORMS="cpu", ABPOA_TPU_SKIP_PROBE="1")
+
+    def gate(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "abpoa_tpu.cli", "perf", "--gate",
+             *extra],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+
+    r = gate()
+    assert r.returncode == 1 and "empty" in r.stderr
+    for i in range(5):
+        ledger.append_record(_mk(ledger, 100.0 + i, i))
+    r = gate()
+    assert r.returncode == 0, r.stderr
+    assert "[perf-drift] PASS" in r.stderr
+    r = gate("--inject-slowdown", "10")
+    assert r.returncode == 1, r.stderr
+    assert "DRIFT" in r.stderr
+
+
+def test_backfill_importer(tmp_path):
+    """>= 15 records from the repo's historical files, idempotent, and
+    the backfilled trajectory passes the drift gate (acceptance: `perf
+    --gate` green on backfill + current)."""
+    d = str(tmp_path / "bf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ABPOA_TPU_SKIP_PROBE="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ledger_backfill.py"),
+         "--ledger-dir", d],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    with open(os.path.join(d, "PERF_LEDGER.jsonl")) as fp:
+        recs = [json.loads(line) for line in fp]
+    assert len(recs) >= 15
+    from abpoa_tpu.obs import ledger
+    assert all(ledger.lint_record(rec) == [] for rec in recs)
+    sources = {rec["source"] for rec in recs}
+    assert {"bench", "shard_gate", "multichip", "abpoa_ref",
+            "perf_gate"} <= sources
+    # re-run: no duplicates
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ledger_backfill.py"),
+         "--ledger-dir", d],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert "0 imported" in r2.stderr, r2.stderr
+    env2 = dict(env, ABPOA_TPU_LEDGER_DIR=d)
+    r3 = subprocess.run(
+        [sys.executable, "-m", "abpoa_tpu.cli", "perf", "--gate"],
+        cwd=REPO, env=env2, capture_output=True, text=True, timeout=120)
+    assert r3.returncode == 0, r3.stderr
+
+
+# ------------------------------------------------------- round timeline
+
+
+def test_round_ring_bounded_drop():
+    from abpoa_tpu.obs import rounds
+    rounds.reset(capacity=16)
+    try:
+        for i in range(40):
+            rounds.record_round("lockstep", lanes=4, k_cap=4,
+                                wall_s=0.001 * (i + 1))
+        ring = rounds.ring()
+        assert ring.total == 40
+        assert rounds.dropped() == 24
+        samples = ring.samples()
+        assert len(samples) == 16
+        # oldest-first, newest retained
+        walls = [s.wall_s for s in samples]
+        assert walls == sorted(walls)
+        assert walls[-1] == pytest.approx(0.040)
+    finally:
+        rounds.reset()
+
+
+def test_shard_wall_estimates_and_skew():
+    from abpoa_tpu.obs import rounds
+    rounds.reset()
+    try:
+        rounds.begin_round()
+        rounds.note_dispatch(0.08, shard_live=[4, 2, 0, 1])
+        s = rounds.record_round("sharded", lanes=7, k_cap=32,
+                                wall_s=0.1, mesh=4)
+        walls = rounds.shard_wall_estimates(s)
+        # straggler (max-live shard) carries the measured dispatch wall
+        assert walls[0] == pytest.approx(0.08)
+        assert walls[1] == pytest.approx(0.04)
+        assert walls[2] == 0.0
+        ratio, straggler = rounds.skew_of(s)
+        assert straggler == 0
+        assert ratio == pytest.approx(4.0)       # 4 live vs min-live 1
+        summ = rounds.skew_summary()
+        assert summ["slowest_shard"] == 0
+        assert summ["shard_skew"] == pytest.approx(4.0)
+        assert summ["shard_live"] == [4, 2, 0, 1]
+    finally:
+        rounds.reset()
+
+
+def test_rounds_reconcile_with_dp_spans():
+    """The round timeline's dispatch walls and the `dp` trace spans
+    bracket the same code region, so their totals agree within 5% on a
+    real lockstep run."""
+    from abpoa_tpu.obs import rounds, trace
+    from abpoa_tpu.parallel.lockstep import progressive_poa_split_batch
+    from abpoa_tpu.params import Params
+    rng = np.random.default_rng(2000)
+    sets, wsets = [], []
+    for n in (3, 4):
+        L = int(rng.integers(60, 120))
+        ref = rng.integers(0, 4, L).astype(np.uint8)
+        reads = []
+        for _ in range(n):
+            r = ref.copy()
+            posn = rng.integers(0, L, max(1, L // 10))
+            r[posn] = rng.integers(0, 4, len(posn))
+            reads.append(r)
+        sets.append(reads)
+        wsets.append([np.ones(len(r), dtype=np.int64) for r in reads])
+    abpt = Params()
+    abpt.device = "jax"
+    abpt.lockstep = "on"
+    abpt.finalize()
+    trace.enable()
+    rounds.reset()
+    try:
+        outs = progressive_poa_split_batch(sets, wsets, abpt)
+        assert all(o is not None for o in outs)
+        ring_total = rounds.dp_wall_total()
+        span_total = trace.span_totals("dp").get("dp_chunk", 0.0)
+        assert ring_total > 0 and span_total > 0
+        assert ring_total == pytest.approx(span_total, rel=0.05)
+        # every round landed a sample with live lanes
+        snap = rounds.snapshot()
+        assert snap and all(s["lanes"] >= 1 for s in snap)
+        assert {s["route"] for s in snap} == {"lockstep"}
+    finally:
+        trace.disable()
+        rounds.reset()
+
+
+def test_top_renders_shard_skew_row():
+    """`top` shows the shard-skew row (max/min shard wall + straggler)
+    once the skew gauges are published — the virtual 8-mesh surface."""
+    from abpoa_tpu.obs import metrics as M
+    from abpoa_tpu.obs.top import render_frame
+    M.reset_registry()
+    try:
+        M.publish_counter("scheduler.sharded.mesh", 1)
+        M.publish_mesh(8, "cpu")
+        M.publish_round("sharded", 0.125, 14, 64)
+        M.publish_shard_skew(2.5, 3, {i: 0.01 * (i + 1) for i in range(8)})
+        samples, types = M.parse_exposition(M.registry().render())
+        frame = render_frame(samples, types, "test.prom", 0.0)
+        assert "skew 2.50x" in frame
+        assert "straggler shard 3" in frame
+        assert "shard 7" in frame                # max-wall shard named
+    finally:
+        M.reset_registry()

@@ -442,7 +442,7 @@ def test_scheduler_metrics_and_top_panel():
     assert not M.lint_exposition(text), M.lint_exposition(text)
     samples, types = M.parse_exposition(text)
     assert M.sample_value(samples, "abpoa_scheduler_routes_total",
-                          route="lockstep") == 1
+                          route="lockstep", reason="eligible") == 1
     assert M.sample_value(samples, "abpoa_lockstep_noop_fraction") == 0.5
     assert M.sample_value(samples, "abpoa_scheduler_route",
                           route="lockstep") == 1

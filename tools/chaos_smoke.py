@@ -253,6 +253,17 @@ def main(argv=None) -> int:
         failures.extend(run_one(spec, tmp, verbose=not args.quiet))
     if args.keep:
         print(f"[chaos-smoke] work dir kept: {tmp}")
+    try:
+        from abpoa_tpu.obs import ledger
+        failures.extend(ledger.append_and_verify(ledger.make_record(
+            "chaos_smoke",
+            workload=f"injectors_{len(specs)}",
+            device="jax",
+            route="pool",
+            verdict="pass" if not failures else "fail",
+            extra={"injectors": [s.split(":")[0] for s in specs]})))
+    except Exception as exc:
+        failures.append(f"ledger append raised: {exc}")
     if failures:
         for f in failures:
             print(f"[chaos-smoke] FAIL: {f}", file=sys.stderr)

@@ -212,6 +212,24 @@ def main(argv=None) -> int:
         rc = 1
     if rc == 0:
         print("[churn-gate] PASS", file=sys.stderr)
+    try:
+        from abpoa_tpu.obs import ledger
+        goodput = (comp.get("goodput_rps") or {}).get("churn")
+        ledger.append_record(ledger.make_record(
+            "churn_gate",
+            workload=f"churn_{'x'.join(map(str, READ_COUNTS))}x{REF_LEN}",
+            device="jax",
+            route="lockstep",
+            rung={"K": int(os.environ.get("ABPOA_TPU_LOCKSTEP_K", "4"))},
+            reads_per_sec=goodput,
+            occupancy=round(churn_occ, 4),
+            verdict="pass" if rc == 0 else "fail",
+            extra={"p99_ms": (comp.get("p99_ms") or {}).get("churn"),
+                   "static_p99_ms": (comp.get("p99_ms") or {}).get("baseline"),
+                   "static_occupancy": round(static_occ, 4),
+                   "joins": joins, "early_retires": retires}))
+    except Exception as exc:  # pragma: no cover - best-effort observability
+        print(f"[churn-gate] ledger append failed: {exc}", file=sys.stderr)
     return rc
 
 

@@ -63,6 +63,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     tmp = tempfile.mkdtemp(prefix="abpoa_fleet_smoke_")
     failures: list = []
+    soak: dict = {}
     payload = os.path.join(DATA, "test.fa")
     oracles = {oracle_body(payload)}
     archive_base = os.path.join(tmp, "reports")
@@ -255,6 +256,24 @@ def main(argv=None) -> int:
             print(f"[fleet-smoke] kept workdir: {tmp}", flush=True)
         else:
             shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        from abpoa_tpu.obs import ledger
+        lm = soak.get("latency_ms") or {}
+        goodput = (round(soak["ok"] / soak["wall_s"], 3)
+                   if soak.get("wall_s") else None)
+        failures.extend(ledger.append_and_verify(ledger.make_record(
+            "fleet_smoke",
+            workload=f"fleet_soak_{args.requests}req",
+            device="jax",
+            route="pool",
+            reads_per_sec=goodput,
+            read_wall_ms={p: lm.get(p) for p in ("p50", "p95", "p99")},
+            verdict="pass" if not failures else "fail",
+            extra={"errors": soak.get("errors"),
+                   "failovers": (soak.get("fleet") or {}).get("failovers")})))
+    except Exception as exc:
+        failures.append(f"ledger append raised: {exc}")
+
     if failures:
         print("\n[fleet-smoke] FAILED:", file=sys.stderr)
         for f in failures:

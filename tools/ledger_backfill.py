@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Backfill the perf ledger from the repo's historical bench records.
+
+The repo accumulated its perf history as loose files: five BENCH_rNN.json
+driver snapshots, the lockstep/pool scaling benches, BENCH_shard.json,
+six MULTICHIP_rNN.json dry-run records, the reference AVX2 walls in
+bench_baseline.json, and the perf_gate anchor in tools/perf_baseline.json.
+This importer adapts each source shape into ledger schema v1 and appends
+them to PERF_LEDGER.jsonl so `abpoa-tpu perf` renders the trajectory from
+round 1 and the drift gate has history on day one.
+
+Every record carries an idempotency key derived from its source file (and
+row index) and goes through `ledger.append_unique`, so re-running the
+importer is a no-op — CI can run it unconditionally before the drift
+gate. Timestamps are the source files' mtimes (the only timestamp those
+files have). Sources that map onto a live appender's (source, workload)
+group — BENCH_shard -> shard_gate, perf_baseline -> perf_gate/map_gate —
+use that group's names so fresh gate runs median against the backfilled
+history.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def _mtime_ts(path: str) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(os.path.getmtime(path)))
+
+
+def _load(path: str):
+    try:
+        with open(path) as fp:
+            return json.load(fp)
+    except (OSError, ValueError) as exc:
+        print(f"[ledger-backfill] skip {os.path.basename(path)}: {exc}",
+              file=sys.stderr)
+        return None
+
+
+def adapt_bench_rounds(ledger, repo: str) -> list:
+    """BENCH_r01..r05.json: the per-round driver snapshots. The headline
+    metric string names the workload and device; early rounds report
+    sim2k, later ones the sim10k_500 consensus."""
+    recs = []
+    for i in range(1, 6):
+        path = os.path.join(repo, f"BENCH_r{i:02d}.json")
+        if not os.path.isfile(path):
+            continue
+        doc = _load(path)
+        parsed = (doc or {}).get("parsed") or {}
+        if not parsed.get("value"):
+            continue
+        metric = parsed.get("metric") or ""
+        workload = "sim10k_500" if "10kb" in metric else "sim2k"
+        device = ""
+        if "device=" in metric:
+            device = metric.split("device=")[1].rstrip(")").split(",")[0]
+        extra = {"vs_baseline": parsed.get("vs_baseline"),
+                 "round": doc.get("n")}
+        extra.update(parsed.get("extra") or {})
+        extra.pop("per_backend", None)
+        recs.append(ledger.make_record(
+            "bench", workload=workload, device=device, route="serial",
+            reads_per_sec=parsed["value"],
+            verdict="pass" if doc.get("rc") == 0 else "fail",
+            ts=_mtime_ts(path), key=f"bf:BENCH_r{i:02d}", extra=extra))
+    return recs
+
+
+def adapt_lockstep(ledger, repo: str) -> list:
+    """BENCH_lockstep_cpu.json rows: K-scaling of the split driver."""
+    path = os.path.join(repo, "BENCH_lockstep_cpu.json")
+    doc = _load(path) if os.path.isfile(path) else None
+    recs = []
+    for j, row in enumerate((doc or {}).get("rows") or []):
+        route = row.get("route") or {}
+        # each K is its own workload: a scaling SWEEP is not a time
+        # series, so different rungs must never median together in the
+        # drift gate
+        recs.append(ledger.make_record(
+            "lockstep_bench",
+            workload=f"k{row.get('k')}_{row.get('n_reads')}"
+                     f"x{row.get('ref_len')}",
+            device="jax",
+            route=f"{route.get('kind')}/{route.get('impl')}",
+            rung={"K": row.get("k")},
+            reads_per_sec=row.get("reads_per_sec"),
+            ts=_mtime_ts(path), key=f"bf:BENCH_lockstep_cpu:{j}",
+            extra={"warm_wall_s": row.get("warm_wall_s"),
+                   "scaling_vs_k1": row.get("scaling_vs_k1")}))
+    return recs
+
+
+def adapt_pool(ledger, repo: str) -> list:
+    """BENCH_pool_cpu.json rows: worker-pool scaling on sim2k sets (20
+    reads per set — bench_baseline's sim2k definition)."""
+    path = os.path.join(repo, "BENCH_pool_cpu.json")
+    doc = _load(path) if os.path.isfile(path) else None
+    recs = []
+    for j, row in enumerate((doc or {}).get("rows") or []):
+        sets_per_s = row.get("sets_per_s")
+        # per-worker-count workloads, same reasoning as the lockstep sweep
+        recs.append(ledger.make_record(
+            "pool_bench", workload=f"sim2k_x16_w{row.get('workers')}",
+            device=doc.get("device") or "", route="pool",
+            rung={"workers": row.get("workers")},
+            reads_per_sec=(sets_per_s * 20 if sets_per_s else None),
+            verdict="pass" if row.get("passes_rule") else "fail",
+            ts=_mtime_ts(path), key=f"bf:BENCH_pool_cpu:{j}",
+            extra={"sets_per_s": sets_per_s,
+                   "speedup_vs_serial": row.get("speedup_vs_serial")}))
+    return recs
+
+
+def adapt_shard(ledger, repo: str) -> list:
+    """BENCH_shard.json: shard_gate --bench's snapshot — imported into
+    shard_gate's own (source, workload) group so live gate runs median
+    against it."""
+    path = os.path.join(repo, "BENCH_shard.json")
+    doc = _load(path) if os.path.isfile(path) else None
+    if not doc:
+        return []
+    sh = doc.get("sharded") or {}
+    return [ledger.make_record(
+        "shard_gate", workload="shard_map_32x2000",
+        device=doc.get("platform") or "", route="sharded",
+        rung={"mesh": doc.get("mesh"), "K": 64},
+        reads_per_sec=sh.get("reads_per_s"),
+        cell_updates_per_sec=sh.get("cups"),
+        occupancy=doc.get("sharded_lane_occupancy"),
+        compile_misses=doc.get("compile_misses_timed"),
+        ts=_mtime_ts(path), key="bf:BENCH_shard",
+        extra={"ratio_vs_unsharded": doc.get("ratio"),
+               "unsharded_reads_per_sec":
+                   (doc.get("unsharded") or {}).get("reads_per_s")})]
+
+
+def adapt_multichip(ledger, repo: str) -> list:
+    """MULTICHIP_r01..r06.json: the 8-device dry-run ok/skip records —
+    no throughput, but the verdict column is the multi-chip trajectory."""
+    recs = []
+    for i in range(1, 7):
+        path = os.path.join(repo, f"MULTICHIP_r{i:02d}.json")
+        if not os.path.isfile(path):
+            continue
+        doc = _load(path)
+        if doc is None:
+            continue
+        skipped = bool(doc.get("skipped"))
+        recs.append(ledger.make_record(
+            "multichip", workload="dryrun", device="tpu", route="sharded",
+            rung={"mesh": doc.get("n_devices")},
+            verdict=(None if skipped
+                     else "pass" if doc.get("ok") else "fail"),
+            ts=_mtime_ts(path), key=f"bf:MULTICHIP_r{i:02d}",
+            extra={"skipped": skipped, "round": i}))
+    return recs
+
+
+def adapt_ref_baseline(ledger, repo: str) -> list:
+    """bench_baseline.json: the out-of-tree AVX2 abPOA reference walls —
+    the floor every bench record's vs_baseline divides by."""
+    path = os.path.join(repo, "bench_baseline.json")
+    doc = _load(path) if os.path.isfile(path) else None
+    recs = []
+    for name, wl in ((doc or {}).get("workloads") or {}).items():
+        wall, n = wl.get("avx2_wall_s"), wl.get("n_reads")
+        if not wall or not n:
+            continue
+        recs.append(ledger.make_record(
+            "abpoa_ref", workload=name, device="avx2", route="serial",
+            reads_per_sec=round(n / wall, 3),
+            ts=_mtime_ts(path), key=f"bf:bench_baseline:{name}",
+            extra={"avx2_wall_s": wall, "n_reads": n}))
+    return recs
+
+
+def adapt_perf_baseline(ledger, repo: str) -> list:
+    """tools/perf_baseline.json: the perf_gate anchor (flat gate schema)
+    plus its map-mode block — imported into perf_gate's and map_gate's
+    groups."""
+    path = os.path.join(repo, "tools", "perf_baseline.json")
+    doc = _load(path) if os.path.isfile(path) else None
+    if not doc:
+        return []
+    recs = [ledger.make_record(
+        "perf_gate", workload=doc.get("workload") or "sim2k",
+        device=doc.get("device") or "", route="serial",
+        reads_per_sec=doc.get("reads_per_sec"),
+        cell_updates_per_sec=doc.get("cell_updates_per_sec"),
+        read_wall_ms=doc.get("read_wall_ms"),
+        compile_misses=doc.get("compile_misses"),
+        ts=_mtime_ts(path), key="bf:perf_baseline",
+        extra={"wall_s": doc.get("wall_s"),
+               "n_reads": doc.get("n_reads")})]
+    mp = doc.get("map") or {}
+    if mp.get("batched_reads_per_sec"):
+        recs.append(ledger.make_record(
+            "map_gate", workload="map_32x2000", device="jax", route="map",
+            rung={"K": 8},
+            reads_per_sec=mp.get("batched_reads_per_sec"),
+            cell_updates_per_sec=mp.get("batched_cell_updates_per_sec"),
+            occupancy=mp.get("lane_occupancy"),
+            compile_misses=mp.get("compile_misses"),
+            ts=_mtime_ts(path), key="bf:perf_baseline:map",
+            extra={"serial_reads_per_sec": mp.get("serial_reads_per_sec"),
+                   "ratio_vs_serial": mp.get("batched_over_serial")}))
+    return recs
+
+
+ADAPTERS = (adapt_bench_rounds, adapt_lockstep, adapt_pool, adapt_shard,
+            adapt_multichip, adapt_ref_baseline, adapt_perf_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO,
+                    help="repo root holding the BENCH_*/MULTICHIP_* files "
+                         "[%(default)s]")
+    ap.add_argument("--ledger-dir", default=None,
+                    help="append to this ledger dir instead of "
+                         "ABPOA_TPU_LEDGER_DIR / the default cache")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the adapted records, append nothing")
+    args = ap.parse_args(argv)
+    if args.ledger_dir:
+        os.environ["ABPOA_TPU_LEDGER_DIR"] = args.ledger_dir
+
+    from abpoa_tpu.obs import ledger
+    records = []
+    for adapter in ADAPTERS:
+        records.extend(adapter(ledger, args.repo))
+    # chronological append order so the trajectory reads oldest-first
+    records.sort(key=lambda r: (r["ts"], r["key"]))
+
+    if args.dry_run:
+        for rec in records:
+            print(json.dumps(rec))
+        print(f"[ledger-backfill] dry run: {len(records)} records adapted",
+              file=sys.stderr)
+        return 0
+
+    imported = skipped = failed = 0
+    for rec in records:
+        bad = ledger.lint_record(rec)
+        if bad:
+            print(f"[ledger-backfill] BAD record {rec.get('key')}: {bad}",
+                  file=sys.stderr)
+            failed += 1
+            continue
+        if ledger.append_unique(rec) is None:
+            skipped += 1
+        else:
+            imported += 1
+    print(f"[ledger-backfill] {imported} imported, {skipped} already "
+          f"present, {failed} rejected -> {ledger.ledger_path()}",
+          file=sys.stderr)
+    if imported and imported + skipped < 15:
+        print("[ledger-backfill] WARNING: fewer than 15 records — source "
+              "files missing?", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -132,6 +132,21 @@ def main(argv=None) -> int:
         rc = 1
     if rc == 0:
         print("[lockstep-gate] PASS", file=sys.stderr)
+    try:
+        from abpoa_tpu.obs import ledger
+        ledger.append_record(ledger.make_record(
+            "lockstep_gate",
+            workload=f"lockstep_k{K}_{N_READS}x{REF_LEN}",
+            device=abpt.device,
+            route=f"{route.kind}/{route.impl}",
+            rung={"K": K},
+            reads_per_sec=round(lock_rps, 3),
+            compile_misses=misses,
+            verdict="pass" if rc == 0 else "fail",
+            extra={"serial_reads_per_sec": round(serial_rps, 3),
+                   "ratio_vs_serial": round(ratio, 4)}))
+    except Exception as exc:  # pragma: no cover - best-effort observability
+        print(f"[lockstep-gate] ledger append failed: {exc}", file=sys.stderr)
     return rc
 
 

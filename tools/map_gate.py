@@ -206,6 +206,23 @@ def main(argv=None) -> int:
         rc = 1
     if rc == 0:
         print("[map-gate] PASS", file=sys.stderr)
+    try:
+        from abpoa_tpu.obs import ledger
+        ledger.append_record(ledger.make_record(
+            "map_gate",
+            workload=f"map_{args.n_reads}x{REF_LEN}",
+            device="jax",
+            route="map",
+            rung={"K": K_CAP},
+            reads_per_sec=round(batched_rps, 3),
+            cell_updates_per_sec=round(batched_cups, 1),
+            occupancy=round(occ, 4),
+            compile_misses=int(misses or 0),
+            verdict="pass" if rc == 0 else "fail",
+            extra={"serial_reads_per_sec": round(serial_rps, 3),
+                   "ratio_vs_serial": round(batched_rps / serial_rps, 4)}))
+    except Exception as exc:  # pragma: no cover - best-effort observability
+        print(f"[map-gate] ledger append failed: {exc}", file=sys.stderr)
     return rc
 
 

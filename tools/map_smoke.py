@@ -223,6 +223,24 @@ def main(argv=None) -> int:
         if args.keep:
             print(f"[map-smoke] work dir kept: {tmp}")
 
+    try:
+        from abpoa_tpu.obs import ledger
+        lm = soak.get("latency_ms") or {}
+        goodput = (round(soak["ok"] / soak["wall_s"], 3)
+                   if soak.get("wall_s") else None)
+        failures.extend(ledger.append_and_verify(ledger.make_record(
+            "map_smoke",
+            workload=f"map_soak_{args.requests}req",
+            device="jax",
+            route="map",
+            reads_per_sec=goodput,
+            read_wall_ms={p: lm.get(p) for p in ("p50", "p95", "p99")},
+            verdict="pass" if not failures else "fail",
+            extra={"errors": soak.get("errors"),
+                   "shed": soak.get("shed")})))
+    except Exception as exc:
+        failures.append(f"ledger append raised: {exc}")
+
     if failures:
         for f in failures:
             print(f"[map-smoke] FAIL: {f}", file=sys.stderr)

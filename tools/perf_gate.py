@@ -248,12 +248,36 @@ def main(argv=None) -> int:
                        {"reads_per_sec": args.rps_threshold,
                         "cell_updates_per_sec": args.cups_threshold},
                        compile_misses_max=args.compile_misses_max)
+    rc = 1 if failures else 0
+    _ledger_append(current, rc)
     if failures:
         for f in failures:
             print(f"[perf-gate] FAIL: {f}", file=sys.stderr)
         return 1
     print("[perf-gate] PASS")
     return 0
+
+
+def _ledger_append(current: dict, rc: int) -> None:
+    """One trajectory record per gate run; a ledger problem never fails
+    the gate itself."""
+    try:
+        sys.path.insert(0, REPO)
+        from abpoa_tpu.obs import ledger
+        ledger.append_record(ledger.make_record(
+            "perf_gate",
+            workload=current.get("workload") or "sim2k",
+            device=current.get("device"),
+            route="serial",
+            reads_per_sec=current.get("reads_per_sec"),
+            cell_updates_per_sec=current.get("cell_updates_per_sec"),
+            read_wall_ms=current.get("read_wall_ms"),
+            compile_misses=current.get("compile_misses"),
+            verdict="pass" if rc == 0 else "fail",
+            extra={"wall_s": current.get("wall_s"),
+                   "n_reads": current.get("n_reads")}))
+    except Exception as exc:  # pragma: no cover - best-effort observability
+        print(f"[perf-gate] ledger append failed: {exc}", file=sys.stderr)
 
 
 if __name__ == "__main__":

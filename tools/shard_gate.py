@@ -390,6 +390,23 @@ def main(argv=None) -> int:
             fp.write("\n")
         print(f"[shard-gate] wrote {out}", file=sys.stderr)
 
+    try:
+        from abpoa_tpu.obs import ledger
+        ledger.append_record(ledger.make_record(
+            "shard_gate",
+            workload=f"shard_map_{args.n_reads}x{REF_LEN}",
+            device=str(mesh.devices.flat[0].platform),
+            route="sharded",
+            rung={"mesh": MESH_N, "K": global_k},
+            reads_per_sec=round(shard_rps, 3),
+            cell_updates_per_sec=round(cells / wall_shard, 1),
+            occupancy=round(occ, 4),
+            compile_misses=int(misses or 0),
+            verdict="pass" if rc == 0 else "fail",
+            extra={"unsharded_reads_per_sec": round(flat_rps, 3),
+                   "ratio_vs_unsharded": round(ratio, 4)}))
+    except Exception as exc:  # pragma: no cover - best-effort observability
+        print(f"[shard-gate] ledger append failed: {exc}", file=sys.stderr)
     print("[shard-gate] " + ("PASS" if rc == 0 else "FAIL"),
           file=sys.stderr)
     return rc
